@@ -1,0 +1,32 @@
+"""TPU-native real-time fraud scoring framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of the reference
+system (AjayAlluri/realtime-fraud-detection): Kafka -> Flink -> 5-model ML
+ensemble -> Redis/decision engine, rebuilt as a single TPU-first framework.
+
+Layer map (mirrors SURVEY.md section 7):
+
+- ``core``     device mesh / precision policy / batch bucketing / compile cache
+- ``features`` the 64-wide feature contract (reference FeatureExtractor.java)
+- ``models``   tensorized GBDT, isolation forest, LSTM, DistilBERT, GraphSAGE
+- ``ensemble`` ensemble strategies + decision ladder (ensemble_predictor.py)
+- ``ops``      Pallas TPU kernels (blockwise attention, tree traversal)
+- ``parallel`` sharding layouts, collectives (the ICI "NCCL" equivalent)
+- ``stream``   transport (in-memory + Kafka-gated) and microbatch assembler
+- ``state``    windowed velocity / profile / history stores (Redis equivalent)
+- ``serving``  asyncio scoring service with the reference REST surface
+- ``sim``      load generator + fraud pattern library
+- ``training`` GBDT / iforest / neural trainers (model_trainer.py equivalent)
+- ``testing``  A/B experiment manager (ab_testing.py equivalent)
+- ``obs``      metrics / structured logging / profiling
+
+Typical use::
+
+    import realtime_fraud_detection_tpu as rtfd
+    cfg = rtfd.Config()
+    scorer = rtfd.serving.Scorer(cfg)
+"""
+
+__version__ = "0.1.0"
+
+from realtime_fraud_detection_tpu.utils.config import Config  # noqa: F401

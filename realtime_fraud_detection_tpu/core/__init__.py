@@ -1,0 +1,15 @@
+from realtime_fraud_detection_tpu.core.mesh import (  # noqa: F401
+    MeshConfig,
+    build_mesh,
+    batch_sharding,
+    replicated_sharding,
+    shard_batch,
+    local_mesh_size,
+)
+from realtime_fraud_detection_tpu.core.precision import Policy, DEFAULT_POLICY  # noqa: F401
+from realtime_fraud_detection_tpu.core.batching import (  # noqa: F401
+    BATCH_BUCKETS,
+    bucket_for,
+    pad_to_bucket,
+    unpad,
+)

@@ -1,0 +1,71 @@
+"""Batch bucketing: pad dynamic microbatches onto a fixed set of shapes.
+
+Everything under ``jit`` is compiled per input shape. A latency-bounded
+microbatcher produces arbitrary batch sizes; compiling per size would be a
+recompile storm. We therefore round every microbatch up to a bucket from
+``BATCH_BUCKETS`` and carry a validity mask. The bucket set matches the
+TF-Serving batching config the reference ships but never exercises
+(reference k8s/manifests/ml-models-deployment.yaml:270-290: allowed sizes
+1..128, max 128) extended to 256 for the TPU's appetite.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import numpy as np
+
+BATCH_BUCKETS: tuple[int, ...] = (1, 8, 32, 128, 256)
+
+
+def bucket_for(n: int, buckets: tuple[int, ...] = BATCH_BUCKETS) -> int:
+    """Smallest bucket >= n; multiples of the largest bucket for huge n."""
+    if n <= 0:
+        raise ValueError(f"batch size must be positive, got {n}")
+    for b in buckets:
+        if n <= b:
+            return b
+    top = buckets[-1]
+    return ((n + top - 1) // top) * top
+
+
+def pad_to_bucket(
+    tree: Any, n: int, buckets: tuple[int, ...] = BATCH_BUCKETS
+) -> Tuple[Any, np.ndarray, int]:
+    """Pad every [n, ...] leaf to the bucket size; return (padded, mask, size).
+
+    Padding replicates row 0 (keeps values in-distribution so padded rows
+    can't produce inf/nan that would poison reductions); the mask is False on
+    padded rows.
+    """
+    size = bucket_for(n, buckets)
+    pad = size - n
+
+    def _pad(x):
+        arr = np.asarray(x)
+        if arr.ndim == 0 or arr.shape[0] != n:
+            return arr
+        if pad == 0:
+            return arr
+        filler = np.broadcast_to(arr[:1], (pad,) + arr.shape[1:])
+        return np.concatenate([arr, filler], axis=0)
+
+    import jax
+
+    padded = jax.tree_util.tree_map(_pad, tree)
+    mask = np.zeros((size,), dtype=bool)
+    mask[:n] = True
+    return padded, mask, size
+
+
+def unpad(tree: Any, n: int) -> Any:
+    """Strip bucket padding back to the true batch size."""
+    import jax
+
+    def _cut(x):
+        arr = np.asarray(x)
+        if arr.ndim == 0:
+            return arr
+        return arr[:n]
+
+    return jax.tree_util.tree_map(_cut, tree)

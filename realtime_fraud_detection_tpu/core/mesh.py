@@ -1,0 +1,104 @@
+"""Device mesh construction and named-sharding helpers.
+
+The reference system's only parallelism is data parallelism (Kafka partitions x
+Flink parallelism 12, SURVEY.md section 2.8). The TPU-native equivalent is a
+``jax.sharding.Mesh`` whose ``data`` axis shards the microbatch across chips
+over ICI; XLA inserts the collectives. Two further axes are first-class from
+day one so tensor parallelism (the BERT branch) and sequence/context
+parallelism are config choices, not rewrites:
+
+- ``data``  - batch dimension (always present; the Flink-parallelism analog)
+- ``model`` - tensor-parallel axis, reserved for the BERT encoder
+- ``seq``   - sequence/context-parallel axis for blockwise attention
+
+Reference parity notes: Flink parallelism=12 over 3 TMs
+(reference docker-compose.yml:265-268) maps to ``data=n_devices`` here.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+AXIS_NAMES = (DATA_AXIS, MODEL_AXIS, SEQ_AXIS)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Logical mesh shape. ``data=None`` means "all remaining devices"."""
+
+    data: int | None = None
+    model: int = 1
+    seq: int = 1
+
+    def resolve(self, n_devices: int) -> tuple[int, int, int]:
+        ms = self.model * self.seq
+        if n_devices % ms != 0:
+            raise ValueError(
+                f"model*seq={ms} does not divide device count {n_devices}"
+            )
+        data = self.data if self.data is not None else n_devices // ms
+        if data * ms != n_devices:
+            raise ValueError(
+                f"mesh {data}x{self.model}x{self.seq} != {n_devices} devices"
+            )
+        return (data, self.model, self.seq)
+
+
+def build_mesh(
+    config: MeshConfig | None = None,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build a 3-axis (data, model, seq) mesh over ``devices``.
+
+    On a single chip this degrades to a (1, 1, 1) mesh so every code path is
+    identical between 1-chip dev and a v5e-8 / multi-host pod.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    config = config or MeshConfig()
+    shape = config.resolve(len(devices))
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, AXIS_NAMES)
+
+
+def local_mesh_size(mesh: Mesh, axis: str = DATA_AXIS) -> int:
+    return mesh.shape[axis]
+
+
+def batch_sharding(mesh: Mesh, extra_dims: int = 0) -> NamedSharding:
+    """Sharding for a [B, ...] tensor: batch over ``data``, rest replicated."""
+    return NamedSharding(mesh, P(DATA_AXIS, *([None] * extra_dims)))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(mesh: Mesh, tree: Any) -> Any:
+    """Device-put every [B, ...] leaf of a pytree sharded over the data axis.
+
+    Host->device transfer point for microbatches: leaves keep their rank, the
+    leading dim is split across the ``data`` axis. Scalars/0-d are replicated.
+    """
+
+    def _put(x):
+        arr = np.asarray(x)
+        if arr.ndim == 0:
+            return jax.device_put(arr, replicated_sharding(mesh))
+        return jax.device_put(arr, batch_sharding(mesh, arr.ndim - 1))
+
+    return jax.tree_util.tree_map(_put, tree)
+
+
+def pad_batch_to_mesh(n: int, mesh: Mesh) -> int:
+    """Smallest batch >= n divisible by the data axis size."""
+    d = local_mesh_size(mesh)
+    return int(math.ceil(n / d) * d)

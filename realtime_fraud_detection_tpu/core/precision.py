@@ -1,0 +1,45 @@
+"""Precision policy: bf16 compute / f32 accumulate, f32 params.
+
+TPU MXUs natively consume bfloat16 with float32 accumulation; the policy
+object makes that the default everywhere while keeping scoring outputs and
+ensemble math in float32 (the decision thresholds in the reference --
+ensemble_predictor.py:344-369 -- are sensitive to ~1e-2, far above bf16 error
+for [0,1] probabilities, but we keep the combine step in f32 anyway).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    param_dtype: jnp.dtype = jnp.float32
+    compute_dtype: jnp.dtype = jnp.bfloat16
+    output_dtype: jnp.dtype = jnp.float32
+
+    def cast_to_compute(self, tree):
+        return jax.tree_util.tree_map(
+            lambda x: x.astype(self.compute_dtype)
+            if jnp.issubdtype(x.dtype, jnp.floating)
+            else x,
+            tree,
+        )
+
+    def cast_to_output(self, tree):
+        return jax.tree_util.tree_map(
+            lambda x: x.astype(self.output_dtype)
+            if jnp.issubdtype(x.dtype, jnp.floating)
+            else x,
+            tree,
+        )
+
+    def matmul_precision(self):
+        return jax.lax.Precision.DEFAULT
+
+
+DEFAULT_POLICY = Policy()
+FULL_PRECISION = Policy(compute_dtype=jnp.float32)

@@ -1,0 +1,10 @@
+from realtime_fraud_detection_tpu.utils.config import (  # noqa: F401
+    Config,
+    ModelConfig,
+    EnsembleConfig,
+    ServingConfig,
+    StreamConfig,
+    SimConfig,
+    MonitoringConfig,
+    MeshSettings,
+)

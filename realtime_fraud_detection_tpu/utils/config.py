@@ -1,0 +1,303 @@
+"""Unified typed configuration tree.
+
+The reference has three disjoint config systems that drift from one another
+(SURVEY.md section 5.6: Java JobConfig.java, Python config.py + an unloaded
+configs/models.json, simulator argparse; the k8s ConfigMap even ships
+*different* ensemble weights). Here there is exactly one tree with layering:
+
+    defaults -> JSON file (``Config.from_file``) -> env vars (``RTFD_*`` and
+    the reference's own names) -> explicit kwargs / CLI.
+
+Model registry semantics mirror reference config.py:126-199 (names, types,
+weights, hyperparameters); ensemble thresholds mirror config.py:118-124 and
+ensemble_predictor.py:344-369.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, List
+
+
+def _env(name: str, default: str, *aliases: str) -> str:
+    for key in (f"RTFD_{name}", name, *aliases):
+        val = os.getenv(key)
+        if val is not None:
+            return val
+    return default
+
+
+@dataclass
+class ModelConfig:
+    """Per-model configuration (reference config.py:9-18)."""
+
+    name: str
+    model_type: str  # 'gbdt' | 'lstm' | 'bert' | 'gnn' | 'isolation_forest'
+    weight: float = 1.0
+    enabled: bool = True
+    model_path: str = ""
+    hyperparameters: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class EnsembleConfig:
+    """Ensemble strategy + decision thresholds (config.py:21-27)."""
+
+    strategy: str = "weighted_average"  # weighted_average | voting | stacking
+    confidence_threshold: float = 0.7
+    fraud_threshold: float = 0.5
+    enable_explanation: bool = True
+    # Decision ladder (ensemble_predictor.py:344-356)
+    decline_threshold: float = 0.95
+    review_threshold: float = 0.8
+    monitor_threshold: float = 0.6
+    # Prediction cache (ensemble_predictor.py:57-58, 460-471)
+    cache_ttl_seconds: float = 300.0
+    cache_max_entries: int = 1000
+
+
+@dataclass
+class MeshSettings:
+    data: int | None = None
+    model: int = 1
+    seq: int = 1
+
+
+@dataclass
+class ServingConfig:
+    """Scoring service settings (reference config.py:72-88 + TF-Serving
+    batching config, k8s/manifests/ml-models-deployment.yaml:270-290)."""
+
+    host: str = "0.0.0.0"
+    port: int = 8080
+    max_concurrent_predictions: int = 100
+    prediction_timeout_seconds: float = 5.0
+    batch_size_limit: int = 1000
+    # Microbatcher: fixed-latency deadline + max batch
+    microbatch_deadline_ms: float = 5.0
+    microbatch_max_size: int = 256
+
+
+@dataclass
+class StreamConfig:
+    """Transport settings (reference JobConfig.java:20-38 semantics)."""
+
+    backend: str = "memory"  # memory | kafka
+    bootstrap_servers: List[str] = field(default_factory=lambda: ["localhost:9092"])
+    transactions_topic: str = "payment-transactions"
+    enriched_topic: str = "transaction-enriched"
+    features_topic: str = "transaction-features"
+    predictions_topic: str = "fraud-predictions"
+    alerts_topic: str = "fraud-alerts"
+    alert_score_threshold: float = 0.7
+    partitions: int = 12
+    checkpoint_interval_ms: int = 10_000
+
+
+@dataclass
+class SimConfig:
+    """Load-generator settings (reference simulator.py:480-489)."""
+
+    tps: int = 100
+    num_users: int = 10_000
+    num_merchants: int = 5_000
+    seed: int = 42
+
+
+@dataclass
+class MonitoringConfig:
+    enable_prometheus: bool = True
+    prometheus_port: int = 8081
+    log_level: str = "INFO"
+    enable_performance_tracking: bool = True
+    enable_drift_detection: bool = True
+
+
+@dataclass
+class StateConfig:
+    """Windowed state store settings (RedisService.java key TTLs)."""
+
+    backend: str = "memory"  # memory | redis
+    redis_host: str = "localhost"
+    redis_port: int = 6379
+    transaction_ttl_s: int = 24 * 3600
+    features_ttl_s: int = 2 * 3600
+    velocity_ttl_s: int = 3600
+    user_history_len: int = 100  # RedisService.java:296-306 last-100 list
+    merchant_history_len: int = 500
+
+
+def _default_models() -> Dict[str, ModelConfig]:
+    """The 5-model registry (reference config.py:126-199)."""
+    return {
+        "xgboost_primary": ModelConfig(
+            name="xgboost_primary",
+            model_type="gbdt",
+            weight=0.40,
+            hyperparameters={
+                "n_estimators": 100,
+                "max_depth": 6,
+                "learning_rate": 0.1,
+                "subsample": 0.8,
+                "colsample_bytree": 0.8,
+            },
+        ),
+        "lstm_sequential": ModelConfig(
+            name="lstm_sequential",
+            model_type="lstm",
+            weight=0.25,
+            hyperparameters={
+                "sequence_length": 10,
+                "hidden_units": 128,
+                "dropout": 0.2,
+            },
+        ),
+        "bert_text": ModelConfig(
+            name="bert_text",
+            model_type="bert",
+            weight=0.15,
+            hyperparameters={
+                "max_length": 128,  # reference uses 512 but its texts are <64 tokens
+                "vocab_size": 30522,
+                "hidden_size": 768,
+                "num_layers": 6,
+                "num_heads": 12,
+                "intermediate_size": 3072,
+            },
+        ),
+        "graph_neural": ModelConfig(
+            name="graph_neural",
+            model_type="gnn",
+            weight=0.15,
+            hyperparameters={
+                "hidden_channels": 64,
+                "num_layers": 3,
+                "dropout": 0.1,
+                "num_neighbors": 16,
+            },
+        ),
+        "isolation_forest": ModelConfig(
+            name="isolation_forest",
+            model_type="isolation_forest",
+            weight=0.05,
+            hyperparameters={
+                "contamination": 0.1,
+                "n_estimators": 100,
+                "random_state": 42,
+            },
+        ),
+    }
+
+
+# Confidence multipliers per model (ensemble_predictor.py:331-337).
+MODEL_CONFIDENCE_MULTIPLIER: Dict[str, float] = {
+    "xgboost_primary": 1.0,
+    "lstm_sequential": 0.8,
+    "bert_text": 0.7,
+    "graph_neural": 0.6,
+    "isolation_forest": 0.5,
+}
+DEFAULT_CONFIDENCE_MULTIPLIER = 0.5
+
+
+@dataclass
+class Config:
+    """Root configuration."""
+
+    service_name: str = "rtfd-tpu"
+    environment: str = "development"
+    models_base_path: str = "artifacts/models"
+    models: Dict[str, ModelConfig] = field(default_factory=_default_models)
+    ensemble: EnsembleConfig = field(default_factory=EnsembleConfig)
+    mesh: MeshSettings = field(default_factory=MeshSettings)
+    serving: ServingConfig = field(default_factory=ServingConfig)
+    stream: StreamConfig = field(default_factory=StreamConfig)
+    state: StateConfig = field(default_factory=StateConfig)
+    sim: SimConfig = field(default_factory=SimConfig)
+    monitoring: MonitoringConfig = field(default_factory=MonitoringConfig)
+
+    def __post_init__(self) -> None:
+        self._apply_env()
+
+    # -- env layering ------------------------------------------------------
+    def _apply_env(self) -> None:
+        self.models_base_path = _env("MODELS_PATH", self.models_base_path)
+        self.serving.port = int(_env("ML_SERVICE_PORT", str(self.serving.port)))
+        self.serving.host = _env("ML_SERVICE_HOST", self.serving.host)
+        self.ensemble.strategy = _env("ENSEMBLE_STRATEGY", self.ensemble.strategy)
+        self.ensemble.confidence_threshold = float(
+            _env("CONFIDENCE_THRESHOLD", str(self.ensemble.confidence_threshold))
+        )
+        self.ensemble.fraud_threshold = float(
+            _env("FRAUD_THRESHOLD", str(self.ensemble.fraud_threshold))
+        )
+        self.monitoring.log_level = _env("LOG_LEVEL", self.monitoring.log_level)
+
+    # -- registry helpers (reference config.py:201-224) --------------------
+    def get_model_config(self, model_name: str) -> ModelConfig:
+        if model_name not in self.models:
+            raise ValueError(f"Model '{model_name}' not found in configuration")
+        return self.models[model_name]
+
+    def get_enabled_models(self) -> Dict[str, ModelConfig]:
+        return {n: c for n, c in self.models.items() if c.enabled}
+
+    def normalized_weights(self) -> Dict[str, float]:
+        enabled = self.get_enabled_models()
+        total = sum(c.weight for c in enabled.values())
+        if total <= 0:
+            return {n: 0.0 for n in enabled}
+        return {n: c.weight / total for n, c in enabled.items()}
+
+    def update_model_weight(self, model_name: str, weight: float) -> None:
+        if model_name in self.models:
+            self.models[model_name].weight = weight
+
+    def disable_model(self, model_name: str) -> None:
+        if model_name in self.models:
+            self.models[model_name].enabled = False
+
+    def enable_model(self, model_name: str) -> None:
+        if model_name in self.models:
+            self.models[model_name].enabled = True
+
+    # -- serialization -----------------------------------------------------
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_file(cls, config_path: str) -> "Config":
+        with open(config_path) as f:
+            data = json.load(f)
+        return cls.from_dict(data)
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> "Config":
+        cfg = cls()
+        _merge_dataclass(cfg, data)
+        return cfg
+
+
+def _merge_dataclass(obj: Any, data: Dict[str, Any]) -> None:
+    """Recursively overlay a dict onto a dataclass tree."""
+    for key, value in data.items():
+        if not hasattr(obj, key):
+            continue
+        current = getattr(obj, key)
+        if dataclasses.is_dataclass(current) and isinstance(value, dict):
+            _merge_dataclass(current, value)
+        elif key == "models" and isinstance(value, dict):
+            for model_name, model_data in value.items():
+                if model_name in current and isinstance(model_data, dict):
+                    for attr, v in model_data.items():
+                        if hasattr(current[model_name], attr):
+                            setattr(current[model_name], attr, v)
+                elif isinstance(model_data, dict) and "model_type" in model_data:
+                    current[model_name] = ModelConfig(
+                        name=model_name, **{k: v for k, v in model_data.items() if k != "name"}
+                    )
+        else:
+            setattr(obj, key, value)

@@ -1,0 +1,39 @@
+"""Test harness: force an 8-device virtual CPU mesh before JAX initializes.
+
+Multi-chip TPU hardware is unavailable in CI; sharding code is validated on
+XLA's host platform with 8 virtual devices (the same path the driver's
+``dryrun_multichip`` uses). Must run before any ``import jax`` resolves a
+backend.
+"""
+
+import os
+
+# Force CPU even when the session env points JAX at real TPU hardware
+# (e.g. JAX_PLATFORMS=axon): tests must be hermetic and multi-device.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+# The image's site config pins jax_platforms to the TPU tunnel ("axon,cpu")
+# regardless of env; override via jax.config before any backend is touched.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    from realtime_fraud_detection_tpu.core import build_mesh
+
+    return build_mesh()
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

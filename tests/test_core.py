@@ -1,0 +1,136 @@
+"""Core runtime tests: mesh construction, sharding, bucketing, config."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from realtime_fraud_detection_tpu.core import (
+    BATCH_BUCKETS,
+    MeshConfig,
+    batch_sharding,
+    bucket_for,
+    build_mesh,
+    pad_to_bucket,
+    shard_batch,
+    unpad,
+)
+from realtime_fraud_detection_tpu.utils.config import Config
+
+
+class TestMesh:
+    def test_eight_virtual_devices(self):
+        assert jax.device_count() == 8
+
+    def test_default_mesh_uses_all_devices(self, mesh8):
+        assert mesh8.shape["data"] == 8
+        assert mesh8.shape["model"] == 1
+        assert mesh8.shape["seq"] == 1
+
+    def test_model_axis_mesh(self):
+        mesh = build_mesh(MeshConfig(model=2))
+        assert mesh.shape["data"] == 4
+        assert mesh.shape["model"] == 2
+
+    def test_invalid_mesh_shape_raises(self):
+        with pytest.raises(ValueError):
+            build_mesh(MeshConfig(data=3, model=2))
+
+    def test_sharded_matmul_matches_local(self, mesh8):
+        x = np.random.default_rng(0).normal(size=(16, 32)).astype(np.float32)
+        w = np.random.default_rng(1).normal(size=(32, 8)).astype(np.float32)
+        xs = jax.device_put(x, batch_sharding(mesh8, 1))
+
+        @jax.jit
+        def f(x, w):
+            return x @ w
+
+        out = f(xs, w)
+        np.testing.assert_allclose(np.asarray(out), x @ w, rtol=1e-5)
+
+    def test_shard_batch_tree(self, mesh8):
+        tree = {"a": np.ones((8, 4), np.float32), "b": np.zeros((8,), np.int32)}
+        sharded = shard_batch(mesh8, tree)
+        assert sharded["a"].sharding.spec[0] == "data"
+        np.testing.assert_array_equal(np.asarray(sharded["a"]), tree["a"])
+
+
+class TestBucketing:
+    def test_bucket_rounding(self):
+        assert bucket_for(1) == 1
+        assert bucket_for(2) == 8
+        assert bucket_for(8) == 8
+        assert bucket_for(33) == 128
+        assert bucket_for(256) == 256
+        assert bucket_for(300) == 512  # multiples of top bucket
+
+    def test_bucket_invalid(self):
+        with pytest.raises(ValueError):
+            bucket_for(0)
+
+    def test_pad_and_unpad_roundtrip(self):
+        tree = {"x": np.arange(12, dtype=np.float32).reshape(6, 2)}
+        padded, mask, size = pad_to_bucket(tree, 6)
+        assert size == 8
+        assert padded["x"].shape == (8, 2)
+        assert mask.sum() == 6
+        # padding replicates row 0 (stays in-distribution)
+        np.testing.assert_array_equal(padded["x"][6], tree["x"][0])
+        restored = unpad(padded, 6)
+        np.testing.assert_array_equal(restored["x"], tree["x"])
+
+    def test_buckets_cover_reference_batching_config(self):
+        # TF-Serving allowed batch sizes 1..128 (ml-models-deployment.yaml)
+        for n in (1, 8, 32, 128):
+            assert n in BATCH_BUCKETS
+
+
+class TestConfig:
+    def test_default_model_registry(self):
+        cfg = Config()
+        assert set(cfg.models) == {
+            "xgboost_primary",
+            "lstm_sequential",
+            "bert_text",
+            "graph_neural",
+            "isolation_forest",
+        }
+        # reference config.py weights
+        assert cfg.models["xgboost_primary"].weight == 0.40
+        assert cfg.models["lstm_sequential"].weight == 0.25
+        assert cfg.models["isolation_forest"].weight == 0.05
+
+    def test_normalized_weights_sum_to_one(self):
+        cfg = Config()
+        assert abs(sum(cfg.normalized_weights().values()) - 1.0) < 1e-9
+
+    def test_disable_model_renormalizes(self):
+        cfg = Config()
+        cfg.disable_model("bert_text")
+        weights = cfg.normalized_weights()
+        assert "bert_text" not in weights
+        assert abs(sum(weights.values()) - 1.0) < 1e-9
+
+    def test_decision_thresholds(self):
+        cfg = Config()
+        assert cfg.ensemble.decline_threshold == 0.95
+        assert cfg.ensemble.review_threshold == 0.8
+        assert cfg.ensemble.monitor_threshold == 0.6
+        assert cfg.ensemble.confidence_threshold == 0.7
+
+    def test_from_dict_overlay(self):
+        cfg = Config.from_dict(
+            {
+                "ensemble": {"strategy": "voting"},
+                "models": {"bert_text": {"enabled": False}},
+                "sim": {"tps": 500},
+            }
+        )
+        assert cfg.ensemble.strategy == "voting"
+        assert not cfg.models["bert_text"].enabled
+        assert cfg.sim.tps == 500
+
+    def test_env_override(self, monkeypatch):
+        monkeypatch.setenv("RTFD_ENSEMBLE_STRATEGY", "stacking")
+        cfg = Config()
+        assert cfg.ensemble.strategy == "stacking"
