@@ -18,27 +18,40 @@ import numpy as np
 BATCH_BUCKETS: tuple[int, ...] = (1, 8, 32, 128, 256)
 
 
-def bucket_for(n: int, buckets: tuple[int, ...] = BATCH_BUCKETS) -> int:
-    """Smallest bucket >= n; multiples of the largest bucket for huge n."""
+def bucket_for(
+    n: int, buckets: tuple[int, ...] = BATCH_BUCKETS, multiple_of: int = 1
+) -> int:
+    """Smallest bucket >= n; multiples of the largest bucket for huge n.
+
+    ``multiple_of`` (typically the mesh ``data``-axis size) guarantees the
+    result is shardable: buckets below it are rounded up to it.
+    """
     if n <= 0:
         raise ValueError(f"batch size must be positive, got {n}")
     for b in buckets:
         if n <= b:
-            return b
+            return max(b, multiple_of) if b % multiple_of else b
     top = buckets[-1]
-    return ((n + top - 1) // top) * top
+    size = ((n + top - 1) // top) * top
+    if size % multiple_of:
+        size = ((size + multiple_of - 1) // multiple_of) * multiple_of
+    return size
 
 
 def pad_to_bucket(
-    tree: Any, n: int, buckets: tuple[int, ...] = BATCH_BUCKETS
+    tree: Any,
+    n: int,
+    buckets: tuple[int, ...] = BATCH_BUCKETS,
+    multiple_of: int = 1,
 ) -> Tuple[Any, np.ndarray, int]:
     """Pad every [n, ...] leaf to the bucket size; return (padded, mask, size).
 
     Padding replicates row 0 (keeps values in-distribution so padded rows
     can't produce inf/nan that would poison reductions); the mask is False on
-    padded rows.
+    padded rows. Pass ``multiple_of=mesh data-axis size`` so the result is
+    always shardable by ``shard_batch``.
     """
-    size = bucket_for(n, buckets)
+    size = bucket_for(n, buckets, multiple_of)
     pad = size - n
 
     def _pad(x):
@@ -58,13 +71,20 @@ def pad_to_bucket(
     return padded, mask, size
 
 
-def unpad(tree: Any, n: int) -> Any:
-    """Strip bucket padding back to the true batch size."""
+def unpad(tree: Any, n: int, padded_size: int | None = None) -> Any:
+    """Strip bucket padding back to the true batch size.
+
+    When ``padded_size`` (the size returned by ``pad_to_bucket``) is given,
+    only leaves whose leading dim equals it are cut — auxiliary leaves that
+    were never padded pass through untouched.
+    """
     import jax
 
     def _cut(x):
         arr = np.asarray(x)
         if arr.ndim == 0:
+            return arr
+        if padded_size is not None and arr.shape[0] != padded_size:
             return arr
         return arr[:n]
 

@@ -1,0 +1,10 @@
+from realtime_fraud_detection_tpu.ensemble.combine import (  # noqa: F401
+    STRATEGIES,
+    WEIGHTED_AVERAGE,
+    VOTING,
+    STACKING,
+    EnsembleParams,
+    combine_predictions,
+    model_confidence,
+    ensemble_decision,
+)

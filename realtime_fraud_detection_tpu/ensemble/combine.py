@@ -1,0 +1,144 @@
+"""Ensemble combination: strategies, confidence, decisions — vectorized.
+
+Mirror of ``EnsemblePredictor``'s math (ensemble_predictor.py:252-369), as a
+single jittable function over a (B, M) prediction matrix instead of
+per-request Python loops. Model failure tolerance (ensemble_predictor.py:
+175-182 — a failed model is skipped and the rest renormalize) becomes a
+``valid`` mask.
+
+The three strategies (:254-323):
+- weighted_average: sum(w*p)/sum(w)
+- voting: fraction of models with p > fraud_threshold
+- stacking: confidence-weighted average, falling back to weighted_average
+  when total confidence is 0.
+
+Per-model confidence (:325-342): min(1, 2*|p-0.5| * multiplier) with the
+multipliers from config (utils/config.py MODEL_CONFIDENCE_MULTIPLIER).
+
+Decision ladder (:344-356): low confidence -> REVIEW; p>=0.95 DECLINE;
+>=0.8 REVIEW; >=0.6 APPROVE_WITH_MONITORING; else APPROVE.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from realtime_fraud_detection_tpu.features.rules import (
+    APPROVE,
+    APPROVE_WITH_MONITORING,
+    DECLINE,
+    REVIEW,
+    risk_level_code,
+)
+from realtime_fraud_detection_tpu.utils.config import (
+    Config,
+    DEFAULT_CONFIDENCE_MULTIPLIER,
+    MODEL_CONFIDENCE_MULTIPLIER,
+)
+
+STRATEGIES: tuple[str, ...] = ("weighted_average", "voting", "stacking")
+WEIGHTED_AVERAGE, VOTING, STACKING = range(3)
+
+
+@struct.dataclass
+class EnsembleParams:
+    """Static ensemble parameters as arrays over the model axis."""
+
+    weights: jax.Array               # f32[M] (normalized over enabled models)
+    confidence_multipliers: jax.Array  # f32[M]
+    strategy: int = struct.field(pytree_node=False, default=WEIGHTED_AVERAGE)
+    fraud_threshold: float = struct.field(pytree_node=False, default=0.5)
+    confidence_threshold: float = struct.field(pytree_node=False, default=0.7)
+
+    @classmethod
+    def from_config(cls, config: Config, model_names: Sequence[str]) -> "EnsembleParams":
+        norm = config.normalized_weights()
+        weights = jnp.asarray([norm.get(n, 0.0) for n in model_names], jnp.float32)
+        mults = jnp.asarray(
+            [MODEL_CONFIDENCE_MULTIPLIER.get(n, DEFAULT_CONFIDENCE_MULTIPLIER)
+             for n in model_names],
+            jnp.float32,
+        )
+        return cls(
+            weights=weights,
+            confidence_multipliers=mults,
+            strategy=STRATEGIES.index(config.ensemble.strategy),
+            fraud_threshold=config.ensemble.fraud_threshold,
+            confidence_threshold=config.ensemble.confidence_threshold,
+        )
+
+
+def model_confidence(preds: jax.Array, multipliers: jax.Array) -> jax.Array:
+    """Per-model confidence (ensemble_predictor.py:325-342). (B,M)->(B,M)."""
+    return jnp.minimum(1.0, jnp.abs(preds - 0.5) * 2.0 * multipliers[None, :])
+
+
+@partial(jax.jit, static_argnames=("with_confidences",))
+def combine_predictions(
+    preds: jax.Array,            # f32[B, M] per-model fraud probabilities
+    valid: jax.Array,            # bool[B, M] or bool[M] — failed models masked
+    params: EnsembleParams,
+    with_confidences: bool = True,
+) -> Dict[str, jax.Array]:
+    """Combine per-model predictions into the final scoring outputs."""
+    if valid.ndim == 1:
+        valid = jnp.broadcast_to(valid[None, :], preds.shape)
+    vf = valid.astype(jnp.float32)
+
+    conf = model_confidence(preds, params.confidence_multipliers) * vf
+    w = params.weights[None, :] * vf
+
+    # weighted average (:263-284)
+    w_total = w.sum(axis=1)
+    wa_prob = jnp.where(w_total > 0, (preds * w).sum(axis=1) / jnp.maximum(w_total, 1e-12), 0.5)
+    wa_conf = jnp.where(w_total > 0, (conf * w).sum(axis=1) / jnp.maximum(w_total, 1e-12), 0.0)
+
+    # voting (:286-303)
+    n_valid = vf.sum(axis=1)
+    votes = ((preds > params.fraud_threshold) & valid).sum(axis=1)
+    vote_prob = jnp.where(n_valid > 0, votes / jnp.maximum(n_valid, 1.0), 0.0)
+    vote_conf = jnp.where(n_valid > 0, conf.sum(axis=1) / jnp.maximum(n_valid, 1.0), 0.0)
+
+    # stacking (:305-323)
+    conf_total = conf.sum(axis=1)
+    stack_prob = jnp.where(
+        conf_total > 0, (preds * conf).sum(axis=1) / jnp.maximum(conf_total, 1e-12), wa_prob
+    )
+    stack_conf = jnp.where(
+        conf_total > 0, conf_total / jnp.maximum(n_valid, 1.0), wa_conf
+    )
+
+    if params.strategy == WEIGHTED_AVERAGE:
+        prob, confidence = wa_prob, wa_conf
+    elif params.strategy == VOTING:
+        prob, confidence = vote_prob, vote_conf
+    else:
+        prob, confidence = stack_prob, stack_conf
+
+    decision = ensemble_decision(prob, confidence, params.confidence_threshold)
+    out = {
+        "fraud_probability": prob,
+        "confidence": confidence,
+        "decision": decision,
+        "risk_level": risk_level_code(prob),
+    }
+    if with_confidences:
+        out["model_confidences"] = conf
+    return out
+
+
+def ensemble_decision(
+    prob: jax.Array, confidence: jax.Array, confidence_threshold: float = 0.7
+) -> jax.Array:
+    """Decision ladder (ensemble_predictor.py:344-356)."""
+    by_prob = jnp.where(
+        prob >= 0.95, DECLINE,
+        jnp.where(prob >= 0.8, REVIEW,
+                  jnp.where(prob >= 0.6, APPROVE_WITH_MONITORING, APPROVE)),
+    )
+    return jnp.where(confidence < confidence_threshold, REVIEW, by_prob).astype(jnp.int32)

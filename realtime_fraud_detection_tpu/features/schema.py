@@ -1,0 +1,310 @@
+"""Transaction batch schema: struct-of-arrays + categorical encodings.
+
+The reference passes transactions around as JSON dicts (simulator.py:78-101)
+and Java POJOs (the reconstructed ``Transaction``/``UserProfile``/
+``MerchantProfile`` of SURVEY.md section 2.10). A TPU program wants dense,
+statically-shaped tensors, so ingest converts a list of transaction records +
+profile lookups into a ``TransactionBatch``: one flat array per field, with
+presence flags standing in for the reference's null checks.
+
+Everything string-shaped (regex merchant-name analysis
+FeatureExtractor.java:427-432, IP/user-agent analysis :434-451, device
+fingerprint membership :307-313) is resolved host-side here, so the
+device-side feature extractor is pure arithmetic.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import fields
+from typing import Any, Dict, List, Mapping, Sequence
+
+import jax
+import numpy as np
+from flax import struct
+
+# --- categorical vocabularies (closed sets from the simulator,
+#     simulator.py:255-266,330-332) -------------------------------------------
+PAYMENT_METHODS = ("credit_card", "debit_card", "digital_wallet", "bank_transfer",
+                   "crypto", "gift_card", "prepaid_card", "wire_transfer")
+TRANSACTION_TYPES = ("purchase", "refund", "authorization")
+CARD_TYPES = ("visa", "mastercard", "amex", "discover")
+MERCHANT_CATEGORIES = ("retail", "grocery", "gas_station", "restaurant",
+                       "online_retail", "gambling", "adult_entertainment",
+                       "pharmacy", "jewelry", "electronics")
+KYC_STATUSES = ("verified", "pending", "rejected")
+RISK_LEVELS = ("low", "medium", "high")
+# Categories the reference treats as high-risk (simulator risk_level='high')
+HIGH_RISK_CATEGORIES = frozenset({"gambling", "adult_entertainment", "jewelry"})
+
+UNKNOWN = -1  # encoding for absent/unknown categorical values
+
+
+def _code(vocab: Sequence[str], value: Any) -> int:
+    if value is None:
+        return UNKNOWN
+    try:
+        return vocab.index(str(value))
+    except ValueError:
+        return UNKNOWN
+
+
+# --- host-side string analysis (FeatureExtractor.java:30-41,427-451) ---------
+_SUSPICIOUS_NAME_RE = re.compile(
+    r"(?i)(bitcoin|crypto|coinbase|binance|blockchain|wallet|mining|exchange"
+    r"|gift\s*card|prepaid|reload|vanilla|amazon\s*gift|itunes"
+    r"|western\s*union|moneygram|remit|transfer|wire|paypal|venmo"
+    r"|casino|gambling|betting|lottery|forex|trading|investment|loan)"
+)
+
+
+def is_suspicious_merchant_name(name: str | None) -> bool:
+    return bool(name) and _SUSPICIOUS_NAME_RE.search(name) is not None
+
+
+def is_private_ip(ip: str | None) -> bool:
+    # FeatureExtractor.java:434-438 (note: the reference only checks 172.16.)
+    return bool(ip) and (
+        ip.startswith("192.168.") or ip.startswith("10.") or ip.startswith("172.16.")
+    )
+
+
+def ip_risk_score(ip: str | None) -> float:
+    # FeatureExtractor.java:440-445
+    if not ip:
+        return 0.3
+    return 0.1 if is_private_ip(ip) else 0.3
+
+
+def is_suspicious_user_agent(ua: str | None) -> bool:
+    # FeatureExtractor.java:447-451
+    if ua is None:
+        return False
+    return "bot" in ua or "crawler" in ua or len(ua) < 20
+
+
+def is_high_risk_payment(method: str | None) -> bool:
+    # FeatureExtractor.java:486-493
+    if not method:
+        return False
+    lower = method.lower()
+    return any(tok in lower for tok in ("prepaid", "gift", "crypto", "wire"))
+
+
+@struct.dataclass
+class TransactionBatch:
+    """Dense batch of transactions + joined profile state.
+
+    All arrays share leading dim B. ``has_*`` flags encode the reference's
+    null checks; when a flag is False the corresponding value fields hold
+    neutral defaults and must be ignored by consumers.
+    """
+
+    # transaction core
+    amount: jax.Array               # f32[B]
+    hour_of_day: jax.Array          # i32[B]
+    day_of_week: jax.Array          # i32[B]  ISO 1=Mon..7=Sun (Java getValue)
+    day_of_month: jax.Array         # i32[B]
+    is_weekend: jax.Array           # bool[B]
+    lat: jax.Array                  # f32[B]
+    lon: jax.Array                  # f32[B]
+    has_geo: jax.Array              # bool[B]
+    merchant_lat: jax.Array         # f32[B]
+    merchant_lon: jax.Array         # f32[B]
+    has_merchant_geo: jax.Array     # bool[B]
+    payment_method_code: jax.Array  # i32[B]
+    transaction_type_code: jax.Array  # i32[B]
+    card_type_code: jax.Array       # i32[B]
+    high_risk_payment: jax.Array    # bool[B] (host-analyzed)
+    suspicious_user_agent: jax.Array  # bool[B] (host-analyzed)
+    private_ip: jax.Array           # bool[B] (host-analyzed)
+    ip_risk: jax.Array              # f32[B] (host-analyzed)
+    prior_fraud_score: jax.Array    # f32[B] (simulator label channel)
+
+    # user profile join (presence = profile found in store)
+    has_user: jax.Array             # bool[B]
+    user_risk_score: jax.Array      # f32[B]
+    account_age_days: jax.Array     # f32[B]
+    user_verified: jax.Array        # bool[B]
+    kyc_code: jax.Array             # i32[B]
+    user_avg_amount: jax.Array      # f32[B]
+    user_txn_frequency: jax.Array   # f32[B]
+    preferred_start: jax.Array      # i32[B]
+    preferred_end: jax.Array        # i32[B]
+    has_preferred_hours: jax.Array  # bool[B]
+    weekend_activity: jax.Array     # f32[B]
+    intl_ratio: jax.Array           # f32[B]
+    has_intl_ratio: jax.Array       # bool[B]
+    online_preference: jax.Array    # f32[B]
+    known_device: jax.Array         # bool[B] (host membership check)
+    has_device_list: jax.Array      # bool[B] (profile carries fingerprints)
+
+    # merchant profile join
+    has_merchant: jax.Array         # bool[B]
+    merchant_risk_code: jax.Array   # i32[B] (RISK_LEVELS index or UNKNOWN)
+    merchant_fraud_rate: jax.Array  # f32[B]
+    merchant_blacklisted: jax.Array  # bool[B]
+    merchant_category_code: jax.Array  # i32[B]
+    merchant_high_risk_category: jax.Array  # bool[B]
+    merchant_op_start: jax.Array    # i32[B]
+    merchant_op_end: jax.Array      # i32[B]
+    has_op_hours: jax.Array         # bool[B]
+    merchant_avg_amount: jax.Array  # f32[B]
+    suspicious_merchant_name: jax.Array  # bool[B] (host regex)
+
+    # velocity state join (5min / 1hour / 24hour windows,
+    # RedisService.java:178-207 key schema)
+    velocity_5min_count: jax.Array   # f32[B]
+    velocity_5min_amount: jax.Array  # f32[B]
+    velocity_1hour_count: jax.Array  # f32[B]
+    velocity_1hour_amount: jax.Array  # f32[B]
+    velocity_24hour_count: jax.Array  # f32[B]
+    velocity_24hour_amount: jax.Array  # f32[B]
+
+    @property
+    def batch_size(self) -> int:
+        return self.amount.shape[0]
+
+
+def merchant_risk_multiplier_code(risk_code: np.ndarray, has_merchant: np.ndarray) -> np.ndarray:
+    """Risk multiplier: low 1.0 / medium 1.5 / high 2.0 / unknown 2.0.
+
+    The reference's ``MerchantProfile.getRiskMultiplier()`` is part of the
+    missing models package; the only observable contract is the
+    unknown-merchant default of 2.0 (FeatureExtractor.java:294).
+    """
+    mult = np.where(risk_code == 0, 1.0, np.where(risk_code == 1, 1.5, 2.0))
+    return np.where(has_merchant, mult, 2.0).astype(np.float32)
+
+
+def encode_transactions(
+    records: Sequence[Mapping[str, Any]],
+    user_profiles: Mapping[str, Mapping[str, Any]] | None = None,
+    merchant_profiles: Mapping[str, Mapping[str, Any]] | None = None,
+    velocities: Mapping[str, Mapping[str, Mapping[str, float]]] | None = None,
+) -> TransactionBatch:
+    """Encode transaction JSON records + profile joins into a dense batch.
+
+    ``records`` follow the simulator schema (simulator.py:78-101).
+    ``user_profiles``/``merchant_profiles`` map ids to profile dicts
+    (simulator.py:40-75 schema). ``velocities`` maps user_id ->
+    {"5min"|"1hour"|"24hour" -> {"count": n, "amount": a}}.
+    """
+    user_profiles = user_profiles or {}
+    merchant_profiles = merchant_profiles or {}
+    velocities = velocities or {}
+    n = len(records)
+
+    cols: Dict[str, np.ndarray] = {
+        f.name: np.zeros((n,), _dtype_for(f.name)) for f in fields(TransactionBatch)
+    }
+
+    for i, rec in enumerate(records):
+        geo = rec.get("geolocation") or {}
+        mgeo = rec.get("merchant_location") or {}
+        cols["amount"][i] = float(rec.get("amount", 0.0))
+        cols["hour_of_day"][i] = int(rec.get("hour_of_day", 12))
+        cols["day_of_week"][i] = int(rec.get("day_of_week", 1))
+        cols["day_of_month"][i] = int(rec.get("day_of_month", 1))
+        cols["is_weekend"][i] = bool(rec.get("is_weekend", False))
+        cols["has_geo"][i] = bool(geo) and geo.get("lat") is not None
+        cols["lat"][i] = float(geo.get("lat", 0.0) or 0.0)
+        cols["lon"][i] = float(geo.get("lon", 0.0) or 0.0)
+        cols["has_merchant_geo"][i] = bool(mgeo) and mgeo.get("lat") is not None
+        cols["merchant_lat"][i] = float(mgeo.get("lat", 0.0) or 0.0)
+        cols["merchant_lon"][i] = float(mgeo.get("lon", 0.0) or 0.0)
+        cols["payment_method_code"][i] = _code(PAYMENT_METHODS, rec.get("payment_method"))
+        cols["transaction_type_code"][i] = _code(TRANSACTION_TYPES, rec.get("transaction_type"))
+        cols["card_type_code"][i] = _code(CARD_TYPES, rec.get("card_type"))
+        cols["high_risk_payment"][i] = is_high_risk_payment(rec.get("payment_method"))
+        cols["suspicious_user_agent"][i] = is_suspicious_user_agent(rec.get("user_agent"))
+        cols["private_ip"][i] = is_private_ip(rec.get("ip_address"))
+        cols["ip_risk"][i] = ip_risk_score(rec.get("ip_address"))
+        cols["prior_fraud_score"][i] = float(rec.get("fraud_score", 0.0))
+
+        user = user_profiles.get(str(rec.get("user_id", "")))
+        cols["has_user"][i] = user is not None
+        if user is not None:
+            patterns = user.get("behavioral_patterns") or {}
+            cols["user_risk_score"][i] = float(user.get("risk_score", 0.5))
+            cols["account_age_days"][i] = float(user.get("account_age_days", 0.0))
+            cols["user_verified"][i] = str(user.get("kyc_status", "")) == "verified"
+            cols["kyc_code"][i] = _code(KYC_STATUSES, user.get("kyc_status"))
+            cols["user_avg_amount"][i] = float(user.get("avg_transaction_amount", 0.0))
+            cols["user_txn_frequency"][i] = float(user.get("transaction_frequency", 0.0))
+            ps, pe = patterns.get("preferred_time_start"), patterns.get("preferred_time_end")
+            cols["has_preferred_hours"][i] = ps is not None and pe is not None
+            cols["preferred_start"][i] = int(ps if ps is not None else 0)
+            cols["preferred_end"][i] = int(pe if pe is not None else 23)
+            cols["weekend_activity"][i] = float(patterns.get("weekend_activity", 0.5))
+            intl = patterns.get("international_transactions")
+            cols["has_intl_ratio"][i] = intl is not None
+            cols["intl_ratio"][i] = float(intl if intl is not None else 0.0)
+            cols["online_preference"][i] = float(patterns.get("online_preference", 0.7))
+            fingerprints = user.get("device_fingerprints") or []
+            cols["has_device_list"][i] = bool(fingerprints)
+            fp = rec.get("device_fingerprint")
+            cols["known_device"][i] = fp is not None and fp in fingerprints
+        else:
+            # unknown-user defaults (FeatureExtractor.java:244-251)
+            cols["user_risk_score"][i] = 0.8
+            cols["kyc_code"][i] = UNKNOWN
+            cols["preferred_end"][i] = 23
+            cols["weekend_activity"][i] = 0.5
+            cols["online_preference"][i] = 0.7
+
+        merch = merchant_profiles.get(str(rec.get("merchant_id", "")))
+        cols["has_merchant"][i] = merch is not None
+        if merch is not None:
+            cols["merchant_risk_code"][i] = _code(RISK_LEVELS, merch.get("risk_level"))
+            cols["merchant_fraud_rate"][i] = float(merch.get("fraud_rate", 0.05))
+            cols["merchant_blacklisted"][i] = bool(merch.get("is_blacklisted", False))
+            cols["merchant_category_code"][i] = _code(MERCHANT_CATEGORIES, merch.get("category"))
+            cols["merchant_high_risk_category"][i] = (
+                str(merch.get("category")) in HIGH_RISK_CATEGORIES
+                or str(merch.get("risk_level")) == "high"
+            )
+            hours = merch.get("operating_hours") or {}
+            cols["has_op_hours"][i] = "start_hour" in hours and "end_hour" in hours
+            cols["merchant_op_start"][i] = int(hours.get("start_hour", 0))
+            cols["merchant_op_end"][i] = int(hours.get("end_hour", 24))
+            cols["merchant_avg_amount"][i] = float(merch.get("avg_transaction_amount", 0.0))
+            cols["suspicious_merchant_name"][i] = is_suspicious_merchant_name(merch.get("name"))
+        else:
+            # unknown-merchant defaults (FeatureExtractor.java:288-295)
+            cols["merchant_risk_code"][i] = UNKNOWN
+            cols["merchant_fraud_rate"][i] = 0.1
+            cols["merchant_category_code"][i] = UNKNOWN
+            cols["merchant_op_end"][i] = 24
+
+        vel = velocities.get(str(rec.get("user_id", ""))) or {}
+        for window, prefix in (("5min", "velocity_5min"), ("1hour", "velocity_1hour"),
+                               ("24hour", "velocity_24hour")):
+            w = vel.get(window) or {}
+            cols[f"{prefix}_count"][i] = float(w.get("count", 0.0))
+            cols[f"{prefix}_amount"][i] = float(w.get("amount", 0.0))
+
+    return TransactionBatch(**cols)
+
+
+_BOOL_FIELDS = {
+    "is_weekend", "has_geo", "has_merchant_geo", "high_risk_payment",
+    "suspicious_user_agent", "private_ip", "has_user", "user_verified",
+    "has_preferred_hours", "has_intl_ratio", "known_device", "has_device_list",
+    "has_merchant", "merchant_blacklisted", "merchant_high_risk_category",
+    "has_op_hours", "suspicious_merchant_name",
+}
+_INT_FIELDS = {
+    "hour_of_day", "day_of_week", "day_of_month", "payment_method_code",
+    "transaction_type_code", "card_type_code", "kyc_code", "preferred_start",
+    "preferred_end", "merchant_risk_code", "merchant_category_code",
+    "merchant_op_start", "merchant_op_end",
+}
+
+
+def _dtype_for(name: str):
+    if name in _BOOL_FIELDS:
+        return np.bool_
+    if name in _INT_FIELDS:
+        return np.int32
+    return np.float32

@@ -1,0 +1,5 @@
+from realtime_fraud_detection_tpu.models.trees import (  # noqa: F401
+    TreeEnsemble,
+    tree_ensemble_predict,
+    tree_ensemble_logits,
+)

@@ -1,0 +1,102 @@
+"""Tensorized gradient-boosted tree inference.
+
+The reference scores XGBoost per request on CPU
+(model_manager.py:309-311, called one transaction at a time from
+ensemble_predictor.py:185-215). Tree traversal is branchy and
+data-dependent — the worst possible shape for XLA — so we re-represent every
+tree as a *complete* binary tree of fixed depth D:
+
+- ``feature``   i32[T, 2^D - 1]  split feature per internal node
+- ``threshold`` f32[T, 2^D - 1]  split threshold (x < t goes left)
+- ``leaf``      f32[T, 2^D]      leaf values (log-odds contributions)
+
+Traversal is then D data-independent gather steps: ``node = 2*node + 1 +
+(x[feature] >= threshold)``. All shapes static, no control flow — the whole
+ensemble jits into a handful of fused gathers on TPU and batches trivially.
+Nodes that the trainer left unsplit get ``threshold=+inf`` so every row routes
+left toward the real leaf (right subtree duplicates it).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+
+@struct.dataclass
+class TreeEnsemble:
+    """Complete-binary-tree GBDT parameters (pytree)."""
+
+    feature: jax.Array    # i32[T, I] with I = 2^depth - 1
+    threshold: jax.Array  # f32[T, I]
+    leaf: jax.Array       # f32[T, L] with L = 2^depth
+    base_score: jax.Array  # f32[] prior logit
+
+    @property
+    def n_trees(self) -> int:
+        return self.feature.shape[0]
+
+    @property
+    def depth(self) -> int:
+        return int(np.log2(self.leaf.shape[1]))
+
+    @classmethod
+    def zeros(cls, n_trees: int, depth: int, prior: float = 0.0) -> "TreeEnsemble":
+        n_internal = 2**depth - 1
+        return cls(
+            feature=jnp.zeros((n_trees, n_internal), jnp.int32),
+            threshold=jnp.full((n_trees, n_internal), jnp.inf, jnp.float32),
+            leaf=jnp.zeros((n_trees, 2**depth), jnp.float32),
+            base_score=jnp.asarray(prior, jnp.float32),
+        )
+
+
+def descend_complete_trees(
+    feature: jax.Array, threshold: jax.Array, x: jax.Array
+) -> jax.Array:
+    """Shared complete-tree traversal: leaf index per (row, tree).
+
+    feature/threshold: [T, 2^D - 1]; x: f32[B, F]. Returns i32[B, T] leaf
+    indices in [0, 2^D). D unrolled data-independent gather steps; the single
+    split convention for the whole framework is **x >= threshold goes
+    right** (GBDT forward, GBDT trainer, isolation forest all share it).
+    """
+    b = x.shape[0]
+    t, n_internal = feature.shape
+    depth = int(np.log2(n_internal + 1))
+
+    feat_flat = feature.reshape(-1)      # [T * I]
+    thr_flat = threshold.reshape(-1)     # [T * I]
+    tree_offset = jnp.arange(t, dtype=jnp.int32) * n_internal  # [T]
+
+    node = jnp.zeros((b, t), jnp.int32)
+    for _ in range(depth):
+        flat = node + tree_offset[None, :]               # [B, T]
+        feat = feat_flat[flat]                           # [B, T]
+        thr = thr_flat[flat]                             # [B, T]
+        xv = jnp.take_along_axis(x, feat, axis=1)        # [B, T]
+        node = 2 * node + 1 + (xv >= thr).astype(jnp.int32)
+    return node - n_internal                              # [B, T] in [0, L)
+
+
+def gather_leaf_values(leaf: jax.Array, leaf_idx: jax.Array) -> jax.Array:
+    """leaf: [T, L], leaf_idx: i32[B, T] -> f32[B, T] values."""
+    t, l = leaf.shape
+    leaf_flat = leaf.reshape(-1)
+    offset = jnp.arange(t, dtype=jnp.int32) * l
+    return leaf_flat[leaf_idx + offset[None, :]]
+
+
+def tree_ensemble_logits(ensemble: TreeEnsemble, x: jax.Array) -> jax.Array:
+    """Raw log-odds for a feature batch. x: f32[B, F] -> f32[B]."""
+    leaf_idx = descend_complete_trees(ensemble.feature, ensemble.threshold, x)
+    values = gather_leaf_values(ensemble.leaf, leaf_idx)
+    return ensemble.base_score + values.sum(axis=1)
+
+
+@jax.jit
+def tree_ensemble_predict(ensemble: TreeEnsemble, x: jax.Array) -> jax.Array:
+    """Fraud probability, the predict_proba[:, 1] equivalent. f32[B]."""
+    return jax.nn.sigmoid(tree_ensemble_logits(ensemble, x))

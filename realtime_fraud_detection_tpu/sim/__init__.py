@@ -1,0 +1,10 @@
+from realtime_fraud_detection_tpu.sim.simulator import (  # noqa: F401
+    UserPool,
+    MerchantPool,
+    TransactionGenerator,
+)
+from realtime_fraud_detection_tpu.sim.fraud_patterns import (  # noqa: F401
+    FraudScenario,
+    AdvancedFraudPatterns,
+    BASIC_FRAUD_MIX,
+)

@@ -1,0 +1,1 @@
+from realtime_fraud_detection_tpu.training.gbdt import GBDTTrainer  # noqa: F401

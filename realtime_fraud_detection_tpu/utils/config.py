@@ -22,6 +22,9 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List
 
 
+VALID_STRATEGIES = ("weighted_average", "voting", "stacking")
+
+
 def _env(name: str, default: str, *aliases: str) -> str:
     for key in (f"RTFD_{name}", name, *aliases):
         val = os.getenv(key)
@@ -221,6 +224,7 @@ class Config:
 
     def __post_init__(self) -> None:
         self._apply_env()
+        self.validate()
 
     # -- env layering ------------------------------------------------------
     def _apply_env(self) -> None:
@@ -278,7 +282,17 @@ class Config:
     def from_dict(cls, data: Dict[str, Any]) -> "Config":
         cfg = cls()
         _merge_dataclass(cfg, data)
+        # env re-applies AFTER the file overlay: defaults -> file -> env
+        cfg._apply_env()
+        cfg.validate()
         return cfg
+
+    def validate(self) -> None:
+        if self.ensemble.strategy not in VALID_STRATEGIES:
+            raise ValueError(
+                f"ensemble.strategy (env RTFD_ENSEMBLE_STRATEGY) must be one of "
+                f"{VALID_STRATEGIES}, got {self.ensemble.strategy!r}"
+            )
 
 
 def _merge_dataclass(obj: Any, data: Dict[str, Any]) -> None:

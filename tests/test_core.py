@@ -134,3 +134,48 @@ class TestConfig:
         monkeypatch.setenv("RTFD_ENSEMBLE_STRATEGY", "stacking")
         cfg = Config()
         assert cfg.ensemble.strategy == "stacking"
+
+
+class TestReviewRegressions:
+    """Regressions for code-review findings."""
+
+    def test_bucket_respects_mesh_multiple(self):
+        assert bucket_for(1, multiple_of=8) == 8
+        assert bucket_for(8, multiple_of=8) == 8
+        assert bucket_for(300, multiple_of=8) == 512
+        assert bucket_for(257, multiple_of=7) == 518  # 512 -> next mult of 7
+
+    def test_pad_to_bucket_shardable_on_mesh(self, mesh8):
+        tree = {"x": np.ones((1, 4), np.float32)}
+        padded, mask, size = pad_to_bucket(tree, 1, multiple_of=8)
+        assert size == 8
+        sharded = shard_batch(mesh8, padded)  # must not raise
+        assert sharded["x"].shape == (8, 4)
+
+    def test_unpad_preserves_non_batch_leaves(self):
+        tree = {"x": np.ones((6, 2)), "emb": np.arange(10)}
+        padded, _, size = pad_to_bucket(tree, 6)
+        out = unpad(padded, 6, padded_size=size)
+        assert out["x"].shape == (6, 2)
+        assert out["emb"].shape == (10,)
+
+    def test_env_beats_file_overlay(self, monkeypatch):
+        monkeypatch.setenv("RTFD_FRAUD_THRESHOLD", "0.9")
+        cfg = Config.from_dict({"ensemble": {"fraud_threshold": 0.5}})
+        assert cfg.ensemble.fraud_threshold == 0.9
+
+    def test_invalid_strategy_rejected_early(self, monkeypatch):
+        monkeypatch.setenv("RTFD_ENSEMBLE_STRATEGY", "majority")
+        with pytest.raises(ValueError, match="RTFD_ENSEMBLE_STRATEGY"):
+            Config()
+
+    def test_serving_matrix_columns_aligned(self):
+        from realtime_fraud_detection_tpu.features.serving import ServingFeatureProcessor
+
+        proc = ServingFeatureProcessor()
+        rows = proc.process_batch([
+            {"amount": 100.0, "user_avg_amount": 50.0,
+             "user_transaction_count_1h": 2, "user_transaction_count_24h": 10},
+            {"amount": 100.0},
+        ])
+        assert list(rows[0].keys()) == list(rows[1].keys())

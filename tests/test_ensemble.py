@@ -1,0 +1,121 @@
+"""Ensemble combination math vs the reference semantics."""
+
+import numpy as np
+import pytest
+
+from realtime_fraud_detection_tpu.ensemble import (
+    STACKING,
+    VOTING,
+    EnsembleParams,
+    combine_predictions,
+    model_confidence,
+)
+from realtime_fraud_detection_tpu.features.rules import DECISIONS
+from realtime_fraud_detection_tpu.utils.config import Config
+
+MODEL_NAMES = ("xgboost_primary", "lstm_sequential", "bert_text",
+               "graph_neural", "isolation_forest")
+
+
+@pytest.fixture(scope="module")
+def params():
+    return EnsembleParams.from_config(Config(), MODEL_NAMES)
+
+
+def _np(x):
+    return np.asarray(x)
+
+
+class TestWeightedAverage:
+    def test_matches_hand_computed(self, params):
+        preds = np.array([[0.9, 0.8, 0.7, 0.6, 0.5]], np.float32)
+        valid = np.ones((1, 5), bool)
+        out = combine_predictions(preds, valid, params)
+        expected = 0.4 * 0.9 + 0.25 * 0.8 + 0.15 * 0.7 + 0.15 * 0.6 + 0.05 * 0.5
+        assert _np(out["fraud_probability"])[0] == pytest.approx(expected, rel=1e-5)
+
+    def test_failed_model_skipped_and_renormalized(self, params):
+        preds = np.array([[0.9, 0.8, 0.0, 0.6, 0.5]], np.float32)
+        valid = np.array([[True, True, False, True, True]])
+        out = combine_predictions(preds, valid, params)
+        w = np.array([0.4, 0.25, 0.15, 0.05])
+        p = np.array([0.9, 0.8, 0.6, 0.5])
+        assert _np(out["fraud_probability"])[0] == pytest.approx(
+            (w * p).sum() / w.sum(), rel=1e-5
+        )
+
+    def test_all_failed_neutral(self, params):
+        preds = np.zeros((1, 5), np.float32)
+        valid = np.zeros((1, 5), bool)
+        out = combine_predictions(preds, valid, params)
+        assert _np(out["fraud_probability"])[0] == pytest.approx(0.5)
+        assert _np(out["confidence"])[0] == 0.0
+
+
+class TestConfidence:
+    def test_multipliers(self, params):
+        # extreme xgb prediction -> confidence 1.0; neutral -> 0
+        preds = np.array([[1.0, 0.5, 0.5, 0.5, 0.5]], np.float32)
+        conf = _np(model_confidence(preds, params.confidence_multipliers))
+        assert conf[0, 0] == pytest.approx(1.0)
+        assert conf[0, 1] == pytest.approx(0.0)
+        # iforest multiplier 0.5: p=1.0 -> 2*0.5*0.5 = 0.5
+        preds = np.array([[0.5, 0.5, 0.5, 0.5, 1.0]], np.float32)
+        conf = _np(model_confidence(preds, params.confidence_multipliers))
+        assert conf[0, 4] == pytest.approx(0.5)
+
+
+class TestStrategies:
+    def test_voting(self):
+        cfg = Config()
+        cfg.ensemble.strategy = "voting"
+        params = EnsembleParams.from_config(cfg, MODEL_NAMES)
+        assert params.strategy == VOTING
+        preds = np.array([[0.9, 0.9, 0.9, 0.2, 0.2]], np.float32)
+        out = combine_predictions(preds, np.ones((1, 5), bool), params)
+        assert _np(out["fraud_probability"])[0] == pytest.approx(3 / 5)
+
+    def test_stacking_confidence_weighted(self):
+        cfg = Config()
+        cfg.ensemble.strategy = "stacking"
+        params = EnsembleParams.from_config(cfg, MODEL_NAMES)
+        assert params.strategy == STACKING
+        preds = np.array([[0.9, 0.6, 0.5, 0.5, 0.5]], np.float32)
+        out = combine_predictions(preds, np.ones((1, 5), bool), params)
+        conf = _np(model_confidence(preds, params.confidence_multipliers))[0]
+        expected = (preds[0] * conf).sum() / conf.sum()
+        assert _np(out["fraud_probability"])[0] == pytest.approx(expected, rel=1e-5)
+
+
+class TestDecisionLadder:
+    def test_low_confidence_forces_review(self, params):
+        # all models mildly positive -> low confidence -> REVIEW
+        preds = np.full((1, 5), 0.55, np.float32)
+        out = combine_predictions(preds, np.ones((1, 5), bool), params)
+        assert float(_np(out["confidence"])[0]) < 0.7
+        assert DECISIONS[int(_np(out["decision"])[0])] == "REVIEW"
+
+    def test_decline_at_95(self, params):
+        preds = np.full((1, 5), 0.99, np.float32)
+        out = combine_predictions(preds, np.ones((1, 5), bool), params)
+        assert DECISIONS[int(_np(out["decision"])[0])] == "DECLINE"
+        assert int(_np(out["risk_level"])[0]) == 4  # CRITICAL
+
+    def test_monitoring_band(self, params):
+        preds = np.full((1, 5), 0.70, np.float32)
+        out = combine_predictions(preds, np.ones((1, 5), bool), params)
+        # confidence = 2*0.2*mult averaged -> below 0.7 threshold? compute:
+        conf = float(_np(out["confidence"])[0])
+        d = DECISIONS[int(_np(out["decision"])[0])]
+        if conf < 0.7:
+            assert d == "REVIEW"
+        else:
+            assert d == "APPROVE_WITH_MONITORING"
+
+    def test_batch_vectorized(self, params):
+        rng = np.random.default_rng(0)
+        preds = rng.random((256, 5)).astype(np.float32)
+        out = combine_predictions(preds, np.ones((256, 5), bool), params)
+        assert out["fraud_probability"].shape == (256,)
+        assert out["decision"].shape == (256,)
+        assert np.isin(_np(out["decision"]), [0, 1, 2, 3]).all()

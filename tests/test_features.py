@@ -1,0 +1,272 @@
+"""Golden-vector tests for the 64-feature contract and rule scoring.
+
+Expected values are hand-derived from the cited reference formulas
+(FeatureExtractor.java, TransactionProcessor.java) — not from the
+implementation under test.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from realtime_fraud_detection_tpu.features import (
+    FEATURE_NAMES,
+    NUM_FEATURES,
+    DECISIONS,
+    encode_transactions,
+    extract_features,
+    feature_index,
+    make_decision,
+    rule_score,
+    risk_level_code,
+)
+from realtime_fraud_detection_tpu.features.serving import ServingFeatureProcessor
+
+USER = {
+    "user_id": "user_a",
+    "risk_score": 0.2,
+    "account_age_days": 400,
+    "kyc_status": "verified",
+    "avg_transaction_amount": 50.0,
+    "transaction_frequency": 3,
+    "device_fingerprints": ["dev1", "dev2"],
+    "behavioral_patterns": {
+        "preferred_time_start": 8,
+        "preferred_time_end": 20,
+        "weekend_activity": 0.6,
+        "international_transactions": 0.05,
+        "online_preference": 0.9,
+    },
+}
+MERCHANT = {
+    "merchant_id": "merchant_a",
+    "name": "Acme Groceries",
+    "category": "grocery",
+    "risk_level": "low",
+    "avg_transaction_amount": 30.0,
+    "fraud_rate": 0.005,
+    "is_blacklisted": False,
+    "operating_hours": {"start_hour": "8", "end_hour": "22"},
+}
+TXN = {
+    "transaction_id": "t1",
+    "user_id": "user_a",
+    "merchant_id": "merchant_a",
+    "amount": 120.0,
+    "currency": "USD",
+    "transaction_type": "purchase",
+    "payment_method": "credit_card",
+    "card_type": "visa",
+    "hour_of_day": 14,
+    "day_of_week": 3,
+    "day_of_month": 15,
+    "is_weekend": False,
+    "ip_address": "8.8.8.8",
+    "device_fingerprint": "dev1",
+    "user_agent": "Mozilla/5.0 (X11; Linux x86_64) AppleWebKit",
+    "geolocation": {"lat": 40.7, "lon": -74.0},
+    "merchant_location": {"lat": 40.8, "lon": -73.9},
+    "fraud_score": 0.1,
+}
+
+
+def fv(batch_or_rows, name):
+    return np.asarray(batch_or_rows)[:, feature_index(name)]
+
+
+class TestFeatureContract:
+    def test_sixty_four_features(self):
+        assert NUM_FEATURES == 64
+        assert len(set(FEATURE_NAMES)) == 64
+
+    def test_known_transaction_golden_values(self):
+        batch = encode_transactions([TXN], {"user_a": USER}, {"merchant_a": MERCHANT})
+        feats = np.asarray(extract_features(batch))
+        assert feats.shape == (1, 64)
+        row = feats[0]
+        get = lambda n: row[feature_index(n)]
+
+        # amount category
+        assert get("amount") == pytest.approx(120.0)
+        assert get("amount_log") == pytest.approx(math.log(121.0), rel=1e-6)
+        assert get("amount_sqrt") == pytest.approx(math.sqrt(120.0), rel=1e-6)
+        assert get("is_round_amount") == 1.0  # 120.00 is integral
+        assert get("is_round_10") == 1.0
+        assert get("is_round_100") == 0.0
+        assert get("amount_to_user_avg_ratio") == pytest.approx(120.0 / 50.0)
+        assert get("amount_deviation_zscore") == pytest.approx((120 - 50) / 50)
+        assert get("is_large_for_user") == 0.0  # ratio 2.4 < 3
+        assert get("amount_to_merchant_avg_ratio") == pytest.approx(4.0)
+        assert get("is_large_for_merchant") == 1.0  # 120 > 60
+        assert get("amount_category") == 2.0  # medium [100, 1000)
+
+        # temporal
+        assert get("hour_of_day") == 14.0
+        assert get("time_period") == 1.0  # afternoon
+        assert get("is_business_hours") == 1.0
+        assert get("is_night_time") == 0.0
+        assert get("in_user_preferred_time") == 1.0  # 8 <= 14 <= 20
+
+        # geographic: haversine of (40.7,-74.0)-(40.8,-73.9)
+        lat1, lon1, lat2, lon2 = map(math.radians, (40.7, -74.0, 40.8, -73.9))
+        a = (math.sin((lat2 - lat1) / 2) ** 2
+             + math.cos(lat1) * math.cos(lat2) * math.sin((lon2 - lon1) / 2) ** 2)
+        expected_km = 6371 * 2 * math.atan2(math.sqrt(a), math.sqrt(1 - a))
+        assert get("distance_to_merchant_km") == pytest.approx(expected_km, rel=1e-4)
+        assert get("is_high_risk_country") == 0.0
+        assert get("user_intl_preference") == pytest.approx(0.05)
+        assert get("unexpected_intl_transaction") == 1.0  # 0.05 < 0.1
+
+        # user
+        assert get("is_new_account") == 0.0
+        assert get("user_risk_score") == pytest.approx(0.2)
+        assert get("is_kyc_verified") == 1.0
+        assert get("kyc_status") == 0.0  # verified
+
+        # merchant
+        assert get("merchant_risk_level") == 0.0  # low
+        assert get("is_high_risk_category") == 0.0
+        assert get("within_merchant_hours") == 1.0
+        assert get("merchant_risk_multiplier") == pytest.approx(1.0)
+        assert get("suspicious_merchant_name") == 0.0
+
+        # device / network
+        assert get("is_known_device") == 1.0
+        assert get("is_new_device") == 0.0
+        assert get("is_private_ip") == 0.0
+        assert get("ip_risk_score") == pytest.approx(0.3)
+        assert get("suspicious_user_agent") == 0.0
+
+        # contextual
+        assert get("is_high_risk_payment") == 0.0
+        assert get("is_refund") == 0.0
+
+    def test_unknown_profiles_defaults(self):
+        batch = encode_transactions([TXN])  # no profile stores
+        row = np.asarray(extract_features(batch))[0]
+        get = lambda n: row[feature_index(n)]
+        # FeatureExtractor.java:244-251 unknown-user defaults
+        assert get("account_age_days") == 0.0
+        assert get("is_new_account") == 1.0
+        assert get("is_very_new_account") == 1.0
+        assert get("user_risk_score") == pytest.approx(0.8)
+        assert get("is_kyc_verified") == 0.0
+        # :288-295 unknown-merchant defaults
+        assert get("merchant_fraud_rate") == pytest.approx(0.1)
+        assert get("is_blacklisted_merchant") == 0.0
+        assert get("is_high_risk_category") == 0.0
+        assert get("merchant_risk_multiplier") == pytest.approx(2.0)
+        assert get("within_merchant_hours") == 1.0  # no info is not "outside"
+
+    def test_suspicious_merchant_regex(self):
+        merch = dict(MERCHANT, name="QuickBitcoin Exchange")
+        batch = encode_transactions([TXN], {"user_a": USER}, {"merchant_a": merch})
+        assert fv(extract_features(batch), "suspicious_merchant_name")[0] == 1.0
+
+    def test_private_ip_and_bad_agent(self):
+        txn = dict(TXN, ip_address="192.168.1.5", user_agent="curl-bot")
+        batch = encode_transactions([txn], {"user_a": USER}, {"merchant_a": MERCHANT})
+        row = np.asarray(extract_features(batch))[0]
+        assert row[feature_index("is_private_ip")] == 1.0
+        assert row[feature_index("ip_risk_score")] == pytest.approx(0.1)
+        assert row[feature_index("suspicious_user_agent")] == 1.0
+
+    def test_velocity_flags(self):
+        vel = {"user_a": {"5min": {"count": 6, "amount": 300.0},
+                          "1hour": {"count": 25, "amount": 1200.0},
+                          "24hour": {"count": 40, "amount": 2000.0}}}
+        batch = encode_transactions([TXN], {"user_a": USER}, {"merchant_a": MERCHANT}, vel)
+        row = np.asarray(extract_features(batch))[0]
+        assert row[feature_index("velocity_5min_count")] == 6.0
+        assert row[feature_index("high_velocity_5min")] == 1.0  # > 5
+        assert row[feature_index("high_velocity_1hour")] == 1.0  # > 20
+        assert row[feature_index("velocity_24hour_amount")] == 2000.0
+
+    def test_batch_shapes_and_vectorization(self):
+        txns = [dict(TXN, amount=float(a)) for a in (5, 50, 500, 5000, 50000)]
+        batch = encode_transactions(txns, {"user_a": USER}, {"merchant_a": MERCHANT})
+        cats = fv(extract_features(batch), "amount_category")
+        np.testing.assert_array_equal(cats, [0, 1, 2, 3, 4])
+
+
+class TestRuleScore:
+    def test_benign_transaction_score(self):
+        batch = encode_transactions([TXN], {"user_a": USER}, {"merchant_a": MERCHANT})
+        score = float(np.asarray(rule_score(batch))[0])
+        # hand-derived: 0.5*0.1 (prior) + 0.2*0.2 (user risk) + 0 (old, verified)
+        # + 0 merchant (low risk, rate .005, not blacklisted) + 0 flags
+        assert score == pytest.approx(0.05 + 0.04, abs=1e-6)
+
+    def test_risky_transaction_score(self):
+        user = dict(USER, risk_score=0.9, account_age_days=5, kyc_status="pending")
+        merch = dict(MERCHANT, risk_level="high", fraud_rate=0.15,
+                     category="gambling", is_blacklisted=False)
+        txn = dict(TXN, fraud_score=0.8, amount=300.0, device_fingerprint="unknown-dev",
+                   hour_of_day=3)
+        batch = encode_transactions([txn], {"user_a": user}, {"merchant_a": merch})
+        score = float(np.asarray(rule_score(batch))[0])
+        # 0.5*0.8 + (0.9*0.2 + 0.1 + 0.15) + (0.2 + 0.15*2 + 0.15 gambling)
+        # + 0.1 new device + 0.05 unusual hour + 0.1 outside hours (3 < 8)
+        expected = 0.4 + 0.43 + 0.65 + 0.25
+        assert score == pytest.approx(min(1.0, expected), abs=1e-6)
+
+    def test_unknown_profiles_minimal_defaults(self):
+        txn = dict(TXN, fraud_score=0.0, hour_of_day=14)
+        batch = encode_transactions([txn])
+        score = float(np.asarray(rule_score(batch))[0])
+        # minimal user 0.35 + minimal merchant 0.1 (TransactionProcessor.java:489-508)
+        assert score == pytest.approx(0.45, abs=1e-6)
+
+    def test_decision_ladder(self):
+        scores = np.array([0.2, 0.55, 0.75, 0.95], np.float32)
+        blk = np.zeros(4, bool)
+        dec, risk = make_decision(scores, blk)
+        assert [DECISIONS[d] for d in np.asarray(dec)] == [
+            "APPROVE", "APPROVE", "REVIEW", "DECLINE"]
+        assert list(np.asarray(risk)) == [1, 2, 3, 4]  # LOW MEDIUM HIGH CRITICAL
+
+    def test_blacklist_override(self):
+        dec, risk = make_decision(np.array([0.1], np.float32), np.array([True]))
+        assert DECISIONS[int(np.asarray(dec)[0])] == "DECLINE"
+        assert int(np.asarray(risk)[0]) == 4
+
+    def test_ensemble_risk_ladder(self):
+        probs = np.array([0.1, 0.4, 0.7, 0.85, 0.99], np.float32)
+        codes = np.asarray(risk_level_code(probs))
+        np.testing.assert_array_equal(codes, [0, 1, 2, 3, 4])
+
+
+class TestServingProcessor:
+    def test_required_feature_missing_raises(self):
+        with pytest.raises(ValueError, match="amount"):
+            ServingFeatureProcessor().process_features({})
+
+    def test_bounds_and_defaults(self):
+        p = ServingFeatureProcessor().process_features(
+            {"amount": 100.0, "hour_of_day": 99, "merchant_fraud_rate": -5}
+        )
+        assert p["hour_of_day"] == 23  # clamped to max
+        assert p["merchant_fraud_rate"] == 0.0  # clamped to min
+        assert p["country_risk_score"] == 0.5  # default
+        assert p["amount_log"] == pytest.approx(math.log1p(100.0))
+        assert p["is_business_hours"] in (0.0, 1.0)
+
+    def test_nan_replaced(self):
+        p = ServingFeatureProcessor().process_features(
+            {"amount": 10.0, "amount_zscore": float("nan")}
+        )
+        assert p["amount_zscore"] == 0.0
+
+    def test_flink_features_dict_merged(self):
+        p = ServingFeatureProcessor().process_features(
+            {"amount": 10.0, "features": {"velocity_score": 0.9}}
+        )
+        assert p["velocity_score"] == pytest.approx(0.9)
+
+    def test_model_matrix_clipped_64(self):
+        proc = ServingFeatureProcessor()
+        rows = proc.process_batch([{"amount": 1e9}, {"amount": 5.0}])
+        mat = proc.to_model_matrix(rows)
+        assert mat.shape[1] >= 64
+        assert mat.max() <= 10.0 and mat.min() >= -10.0

@@ -1,0 +1,57 @@
+"""Isolation forest tensorization tests."""
+
+import numpy as np
+
+from realtime_fraud_detection_tpu.models.isolation_forest import (
+    IsolationForestTrainer,
+    iforest_predict,
+    iforest_scores,
+)
+
+
+def _data(seed=0, n=2000, f=8):
+    rng = np.random.default_rng(seed)
+    normal = rng.normal(0, 1, size=(n, f)).astype(np.float32)
+    outliers = rng.normal(0, 1, size=(50, f)).astype(np.float32) + 8.0
+    return normal, outliers
+
+
+class TestIsolationForest:
+    def test_outliers_score_higher(self):
+        normal, outliers = _data()
+        forest = IsolationForestTrainer(n_estimators=50, seed=1).fit(normal)
+        s_norm = np.asarray(iforest_scores(forest, normal[:200]))
+        s_out = np.asarray(iforest_scores(forest, outliers))
+        assert s_out.mean() > s_norm.mean() + 0.1
+        assert (s_out > 0).all() and (s_out <= 1).all()
+
+    def test_sigmoid_probability_mapping(self):
+        # model_manager.py:338-346: p = 1/(1+exp(0.5 - s)); anomalous rows
+        # (s near 1) must map to higher fraud probability than normal rows
+        normal, outliers = _data(seed=2)
+        forest = IsolationForestTrainer(n_estimators=50, seed=3).fit(normal)
+        p_norm = np.asarray(iforest_predict(forest, normal[:200]))
+        p_out = np.asarray(iforest_predict(forest, outliers))
+        assert p_out.mean() > p_norm.mean()
+        assert (p_norm > 0).all() and (p_norm < 1).all()
+
+    def test_agrees_with_sklearn_ranking(self):
+        from sklearn.ensemble import IsolationForest as SkIF
+
+        normal, outliers = _data(seed=4)
+        x_test = np.concatenate([normal[:100], outliers[:20]])
+        ours = IsolationForestTrainer(n_estimators=100, seed=5).fit(normal)
+        sk = SkIF(n_estimators=100, random_state=5).fit(normal)
+        ours_s = np.asarray(iforest_scores(ours, x_test))
+        sk_s = -sk.score_samples(x_test)  # sklearn: higher = more anomalous
+        # rank correlation between the two scorings should be strong
+        from scipy.stats import spearmanr
+
+        rho = spearmanr(ours_s, sk_s).statistic
+        assert rho > 0.8, f"spearman {rho:.3f}"
+
+    def test_deterministic(self):
+        normal, _ = _data(seed=6)
+        a = IsolationForestTrainer(n_estimators=10, seed=7).fit(normal)
+        b = IsolationForestTrainer(n_estimators=10, seed=7).fit(normal)
+        np.testing.assert_array_equal(np.asarray(a.threshold), np.asarray(b.threshold))
