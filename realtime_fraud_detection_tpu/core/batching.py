@@ -28,14 +28,17 @@ def bucket_for(
     """
     if n <= 0:
         raise ValueError(f"batch size must be positive, got {n}")
+
+    def _round_up(size: int) -> int:
+        if size % multiple_of:
+            size = ((size + multiple_of - 1) // multiple_of) * multiple_of
+        return size
+
     for b in buckets:
         if n <= b:
-            return max(b, multiple_of) if b % multiple_of else b
+            return _round_up(b)
     top = buckets[-1]
-    size = ((n + top - 1) // top) * top
-    if size % multiple_of:
-        size = ((size + multiple_of - 1) // multiple_of) * multiple_of
-    return size
+    return _round_up(((n + top - 1) // top) * top)
 
 
 def pad_to_bucket(

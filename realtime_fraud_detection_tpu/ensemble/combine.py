@@ -39,9 +39,11 @@ from realtime_fraud_detection_tpu.utils.config import (
     Config,
     DEFAULT_CONFIDENCE_MULTIPLIER,
     MODEL_CONFIDENCE_MULTIPLIER,
+    VALID_STRATEGIES,
 )
 
-STRATEGIES: tuple[str, ...] = ("weighted_average", "voting", "stacking")
+# single source of truth lives in utils.config (Config.validate checks it)
+STRATEGIES: tuple[str, ...] = VALID_STRATEGIES
 WEIGHTED_AVERAGE, VOTING, STACKING = range(3)
 
 

@@ -149,7 +149,7 @@ def extract_features(b: TransactionBatch) -> jax.Array:
     cols = [
         # amount
         amount,
-        jnp.log(amount + 1.0),
+        jnp.log1p(jnp.maximum(amount, 0.0)),
         jnp.sqrt(jnp.maximum(amount, 0.0)),
         f32(cents % 100 == 0),
         f32(cents % 1000 == 0),

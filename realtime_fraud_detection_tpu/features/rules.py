@@ -61,7 +61,11 @@ def rule_score(b: TransactionBatch) -> jax.Array:
     large_amount = b.has_user & (b.user_avg_amount > 0) & (
         b.amount / jnp.maximum(b.user_avg_amount, 1e-9) > 5.0
     )
-    new_device = b.has_user & b.has_device_list & ~b.known_device
+    # reference requires the txn to actually carry a fingerprint
+    # (TransactionProcessor.java:252-262) — no penalty when it's absent
+    new_device = (
+        b.has_txn_fingerprint & b.has_user & b.has_device_list & ~b.known_device
+    )
     unusual_hour = (b.hour_of_day <= 5) | (b.hour_of_day >= 23)
     outside_hours = b.has_merchant & b.has_op_hours & ~(
         (b.hour_of_day >= b.merchant_op_start) & (b.hour_of_day <= b.merchant_op_end)

@@ -138,6 +138,7 @@ class TransactionBatch:
     online_preference: jax.Array    # f32[B]
     known_device: jax.Array         # bool[B] (host membership check)
     has_device_list: jax.Array      # bool[B] (profile carries fingerprints)
+    has_txn_fingerprint: jax.Array  # bool[B] (transaction carried a fingerprint)
 
     # merchant profile join
     has_merchant: jax.Array         # bool[B]
@@ -164,17 +165,6 @@ class TransactionBatch:
     @property
     def batch_size(self) -> int:
         return self.amount.shape[0]
-
-
-def merchant_risk_multiplier_code(risk_code: np.ndarray, has_merchant: np.ndarray) -> np.ndarray:
-    """Risk multiplier: low 1.0 / medium 1.5 / high 2.0 / unknown 2.0.
-
-    The reference's ``MerchantProfile.getRiskMultiplier()`` is part of the
-    missing models package; the only observable contract is the
-    unknown-merchant default of 2.0 (FeatureExtractor.java:294).
-    """
-    mult = np.where(risk_code == 0, 1.0, np.where(risk_code == 1, 1.5, 2.0))
-    return np.where(has_merchant, mult, 2.0).astype(np.float32)
 
 
 def encode_transactions(
@@ -221,6 +211,7 @@ def encode_transactions(
         cols["private_ip"][i] = is_private_ip(rec.get("ip_address"))
         cols["ip_risk"][i] = ip_risk_score(rec.get("ip_address"))
         cols["prior_fraud_score"][i] = float(rec.get("fraud_score", 0.0))
+        cols["has_txn_fingerprint"][i] = rec.get("device_fingerprint") is not None
 
         user = user_profiles.get(str(rec.get("user_id", "")))
         cols["has_user"][i] = user is not None
@@ -289,7 +280,8 @@ def encode_transactions(
 
 _BOOL_FIELDS = {
     "is_weekend", "has_geo", "has_merchant_geo", "high_risk_payment",
-    "suspicious_user_agent", "private_ip", "has_user", "user_verified",
+    "suspicious_user_agent", "private_ip", "has_txn_fingerprint", "has_user",
+    "user_verified",
     "has_preferred_hours", "has_intl_ratio", "known_device", "has_device_list",
     "has_merchant", "merchant_blacklisted", "merchant_high_risk_category",
     "has_op_hours", "suspicious_merchant_name",

@@ -1,0 +1,164 @@
+"""GraphSAGE user-merchant network scorer.
+
+The reference's "GNN" is a 3-layer MLP over the 64-feature vector
+(model_manager.py:202-242) with graph statistics bolted on host-side
+(graph_neural_network.py:244-315, last-100-transaction entity graph). The
+baseline contract (BASELINE.json config 5) asks for a real **GraphSAGE
+user-merchant network scorer**, so that is what this is:
+
+- node features: user nodes and merchant nodes carry small profile-stat
+  vectors (padded to a common node_dim);
+- one SAGE layer per hop: h' = relu(W [h_self ; mean(h_neighbors)]) with
+  mask-aware mean over a fixed fan-out K (padded neighbor tensors from
+  state.EntityGraphStore — dense, static shapes, vmap-free batching);
+- the scored edge (user u, merchant m) combines both embeddings with the
+  transaction's 64-feature vector through an MLP head.
+
+Two-hop batching: neighbors-of-neighbors arrive as [B, K, K] tensors; the
+first SAGE layer embeds the 1-hop frontier using 2-hop aggregates, the
+second embeds the centers. All gathers are host-prepared index tensors; the
+device sees only dense matmuls and masked means (MXU + VPU, no scatter).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def init_gnn_params(
+    key: jax.Array,
+    node_dim: int = 16,
+    txn_dim: int = 64,
+    hidden: int = 64,
+    head_hidden: int = 64,
+) -> Dict[str, jax.Array]:
+    """GraphSAGE (2 layers) + head parameters (config.py:177-184: hidden 64,
+    3 layers total counting the head, dropout 0.1)."""
+    ks = jax.random.split(key, 6)
+
+    def glorot(k, shape):
+        return jax.random.normal(k, shape, jnp.float32) * float(
+            np.sqrt(2.0 / (shape[0] + shape[1]))
+        )
+
+    return {
+        # layer 1: embeds the 1-hop frontier from raw node features
+        "w_sage1": glorot(ks[0], (2 * node_dim, hidden)),
+        "b_sage1": jnp.zeros((hidden,), jnp.float32),
+        # layer 2: embeds the centers from (raw self, hidden neighbors)
+        "w_sage2": glorot(ks[1], (node_dim + hidden, hidden)),
+        "b_sage2": jnp.zeros((hidden,), jnp.float32),
+        "w_head1": glorot(ks[2], (2 * hidden + txn_dim, head_hidden)),
+        "b_head1": jnp.zeros((head_hidden,), jnp.float32),
+        "w_head2": glorot(ks[3], (head_hidden, 1)),
+        "b_head2": jnp.zeros((1,), jnp.float32),
+    }
+
+
+def _masked_mean(x: jax.Array, mask: jax.Array) -> jax.Array:
+    """Mean over axis -2 where mask, else zeros. x: [..., K, D], mask [..., K]."""
+    m = mask[..., None].astype(x.dtype)
+    total = (x * m).sum(axis=-2)
+    count = jnp.maximum(m.sum(axis=-2), 1.0)
+    return total / count
+
+
+def _sage(w, b, self_feat, neigh_feat, neigh_mask):
+    agg = _masked_mean(neigh_feat, neigh_mask)
+    z = jnp.concatenate([self_feat, agg], axis=-1)
+    return jax.nn.relu(z @ w + b)
+
+
+def gnn_logits(
+    params: Dict[str, jax.Array],
+    txn_features: jax.Array,     # f32[B, 64]
+    user_feat: jax.Array,        # f32[B, node_dim] center user nodes
+    merchant_feat: jax.Array,    # f32[B, node_dim] center merchant nodes
+    user_neigh_feat: jax.Array,  # f32[B, K, node_dim] merchants around user
+    user_neigh_mask: jax.Array,  # bool[B, K]
+    merch_neigh_feat: jax.Array,  # f32[B, K, node_dim] users around merchant
+    merch_neigh_mask: jax.Array,  # bool[B, K]
+    user_neigh2_feat: jax.Array | None = None,   # f32[B, K, K, node_dim]
+    user_neigh2_mask: jax.Array | None = None,   # bool[B, K, K]
+    merch_neigh2_feat: jax.Array | None = None,  # f32[B, K, K, node_dim]
+    merch_neigh2_mask: jax.Array | None = None,  # bool[B, K, K]
+) -> jax.Array:
+    """Fraud logit per scored (user, merchant, txn) edge. f32[B]."""
+    def _empty_frontier(x):
+        # [B, K, 1, D] zeros with an all-False mask -> masked mean yields 0
+        return x[..., None, :] * 0.0, jnp.zeros(x.shape[:-1] + (1,), bool)
+
+    # layer 1: embed 1-hop frontier (uses 2-hop context when provided)
+    if user_neigh2_feat is None:
+        user_neigh2_feat, user_neigh2_mask = _empty_frontier(user_neigh_feat)
+    if merch_neigh2_feat is None:
+        merch_neigh2_feat, merch_neigh2_mask = _empty_frontier(merch_neigh_feat)
+    u_frontier = _sage(params["w_sage1"], params["b_sage1"],
+                       user_neigh_feat, user_neigh2_feat, user_neigh2_mask)
+    m_frontier = _sage(params["w_sage1"], params["b_sage1"],
+                       merch_neigh_feat, merch_neigh2_feat, merch_neigh2_mask)
+
+    # layer 2: embed the centers from their (raw, embedded-frontier) context
+    h_user = _sage(params["w_sage2"], params["b_sage2"],
+                   user_feat, u_frontier, user_neigh_mask)
+    h_merch = _sage(params["w_sage2"], params["b_sage2"],
+                    merchant_feat, m_frontier, merch_neigh_mask)
+
+    z = jnp.concatenate([h_user, h_merch, txn_features], axis=-1)
+    z = jax.nn.relu(z @ params["w_head1"] + params["b_head1"])
+    return (z @ params["w_head2"] + params["b_head2"])[:, 0]
+
+
+@jax.jit
+def gnn_predict(params, txn_features, user_feat, merchant_feat,
+                user_neigh_feat, user_neigh_mask,
+                merch_neigh_feat, merch_neigh_mask) -> jax.Array:
+    """1-hop fraud probability (the serving path; 2-hop is a training option)."""
+    return jax.nn.sigmoid(gnn_logits(
+        params, txn_features, user_feat, merchant_feat,
+        user_neigh_feat, user_neigh_mask, merch_neigh_feat, merch_neigh_mask,
+    ))
+
+
+def build_node_features(
+    user_pool, merchant_pool, node_dim: int = 16
+) -> tuple[np.ndarray, np.ndarray]:
+    """Static node feature tables from the profile pools.
+
+    user nodes:   [risk, log-avg-amount, freq, age/365, verified, weekend,
+                   intl, online] zero-padded to node_dim
+    merchant nodes: [risk_code/2, fraud_rate, log-avg-amount, blacklisted,
+                   category/10, op_start/24, op_end/24] zero-padded.
+    """
+    u = np.zeros((user_pool.n, node_dim), np.float32)
+    u[:, 0] = user_pool.risk_score
+    u[:, 1] = np.log1p(user_pool.avg_amount)
+    u[:, 2] = user_pool.txn_frequency
+    u[:, 3] = user_pool.account_age_days / 365.0
+    u[:, 4] = (user_pool.kyc_code == 0)
+    u[:, 5] = user_pool.weekend_activity
+    u[:, 6] = user_pool.intl_ratio
+    u[:, 7] = user_pool.online_preference
+
+    m = np.zeros((merchant_pool.n, node_dim), np.float32)
+    m[:, 0] = merchant_pool.risk_code / 2.0
+    m[:, 1] = merchant_pool.fraud_rate
+    m[:, 2] = np.log1p(merchant_pool.avg_amount)
+    m[:, 3] = merchant_pool.is_blacklisted
+    m[:, 4] = merchant_pool.category_code / 10.0
+    m[:, 5] = merchant_pool.op_start / 24.0
+    m[:, 6] = merchant_pool.op_end / 24.0
+    m[:, 8] = 1.0  # type tag distinguishing merchant nodes from user nodes
+    return u, m
+
+
+def gather_neighbor_features(
+    node_table: np.ndarray, idx: np.ndarray, mask: np.ndarray
+) -> np.ndarray:
+    """Safe gather: padded (-1) indices read row 0 but are masked out."""
+    safe = np.where(mask, idx, 0)
+    return node_table[safe]

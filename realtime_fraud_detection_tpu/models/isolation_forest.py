@@ -98,10 +98,13 @@ class IsolationForestTrainer:
                 if rows is None:
                     continue
                 level = int(np.log2(node + 1))
+                if len(rows) <= 1:
+                    self._seal(node, level, depth, len(rows), thr[t], plen[t])
+                    continue
                 sub = x[rows]
                 lo, hi = sub.min(axis=0), sub.max(axis=0)
                 splittable = np.where(hi > lo)[0]
-                if len(rows) <= 1 or splittable.size == 0:
+                if splittable.size == 0:
                     self._seal(node, level, depth, len(rows), thr[t], plen[t])
                     continue
                 j = int(rng.choice(splittable))

@@ -1,0 +1,100 @@
+"""LSTM sequential fraud model.
+
+Capability mirror of ``lstm_sequential`` (reference config.py:151-157:
+sequence_length 10, 128 hidden units, dropout 0.2; served via Keras
+``model.predict`` one request at a time, model_manager.py:313-319). Rebuilt
+TPU-first:
+
+- single fused gate matmul per step: x@Wx + h@Wh is one (B, F+H) x (F+H, 4H)
+  MXU call after concatenation;
+- ``lax.scan`` over the (static) sequence axis — no Python loops in jit;
+- front-padded sequences with a step mask so short histories keep their
+  state instead of ingesting pad zeros;
+- bf16 compute / f32 state per the global precision policy.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def init_lstm_params(
+    key: jax.Array,
+    feature_dim: int = 64,
+    hidden: int = 128,
+    head_hidden: int = 64,
+) -> Dict[str, jax.Array]:
+    """Glorot-initialized LSTM + MLP-head parameters (pytree)."""
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    scale_in = float(np.sqrt(2.0 / (feature_dim + hidden + 4 * hidden)))
+    params = {
+        "w_gates": jax.random.normal(k1, (feature_dim + hidden, 4 * hidden), jnp.float32) * scale_in,
+        "b_gates": jnp.zeros((4 * hidden,), jnp.float32),
+        "w_head1": jax.random.normal(k2, (hidden, head_hidden), jnp.float32)
+        * float(np.sqrt(2.0 / hidden)),
+        "b_head1": jnp.zeros((head_hidden,), jnp.float32),
+        "w_head2": jax.random.normal(k3, (head_hidden, 1), jnp.float32)
+        * float(np.sqrt(2.0 / head_hidden)),
+        "b_head2": jnp.zeros((1,), jnp.float32),
+    }
+    # forget-gate bias init to 1 (standard stabilizer)
+    hidden_slice = jnp.zeros((4 * hidden,)).at[hidden : 2 * hidden].set(1.0)
+    params["b_gates"] = params["b_gates"] + hidden_slice
+    del k4
+    return params
+
+
+def lstm_logits(
+    params: Dict[str, jax.Array],
+    sequences: jax.Array,       # f32[B, T, F] front-padded
+    lengths: jax.Array | None = None,  # i32[B] valid suffix lengths
+    compute_dtype=jnp.bfloat16,
+) -> jax.Array:
+    """Fraud logit per sequence. f32[B]."""
+    b, t, f = sequences.shape
+    hidden = params["w_head1"].shape[0]
+    w = params["w_gates"].astype(compute_dtype)
+    bg = params["b_gates"].astype(jnp.float32)
+
+    if lengths is None:
+        step_valid = jnp.ones((t, b), bool)
+    else:
+        # front-padded: step i is valid iff i >= T - length
+        idx = jnp.arange(t)[:, None]
+        step_valid = idx >= (t - lengths)[None, :]
+
+    xs = jnp.swapaxes(sequences, 0, 1).astype(compute_dtype)  # [T, B, F]
+
+    def step(carry, inp):
+        h, c = carry
+        x, valid = inp
+        z = jnp.concatenate([x, h.astype(compute_dtype)], axis=-1) @ w
+        z = z.astype(jnp.float32) + bg
+        i, fg, g, o = jnp.split(z, 4, axis=-1)
+        i, fg, o = jax.nn.sigmoid(i), jax.nn.sigmoid(fg), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c_new = fg * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        m = valid[:, None]
+        return (jnp.where(m, h_new, h), jnp.where(m, c_new, c)), None
+
+    h0 = jnp.zeros((b, hidden), jnp.float32)
+    c0 = jnp.zeros((b, hidden), jnp.float32)
+    (h, _), _ = jax.lax.scan(step, (h0, c0), (xs, step_valid))
+
+    z = jax.nn.relu(h @ params["w_head1"] + params["b_head1"])
+    return (z @ params["w_head2"] + params["b_head2"])[:, 0]
+
+
+@jax.jit
+def lstm_predict(
+    params: Dict[str, jax.Array],
+    sequences: jax.Array,
+    lengths: jax.Array | None = None,
+) -> jax.Array:
+    """Fraud probability per sequence. f32[B]."""
+    return jax.nn.sigmoid(lstm_logits(params, sequences, lengths))

@@ -293,7 +293,9 @@ class TransactionGenerator:
         hour = ((secs // 3600) % 24).astype(np.int32)
         day_index = (secs // 86400).astype(np.int64)
         day_of_week = ((day_index % 7) + 1).astype(np.int32)  # base is a Monday
-        day_of_month = ((day_index % 28) + 5).astype(np.int32) % 28 + 1
+        # base date is the 5th; wrap within a 28-day month (dict path uses
+        # real calendar days — equal on day 0, may drift at month ends)
+        day_of_month = ((day_index + 4) % 28 + 1).astype(np.int32)
         self.clock = base + timedelta(seconds=float(n / self.tps))
 
         intl = rng.random(n) < up.intl_ratio[u]
@@ -344,7 +346,7 @@ class TransactionGenerator:
             amount=amount.astype(np.float32),
             hour_of_day=hour,
             day_of_week=day_of_week,
-            day_of_month=day_of_month.astype(np.int32),
+            day_of_month=day_of_month,
             is_weekend=day_of_week >= 6,
             lat=lat.astype(np.float32),
             lon=lon.astype(np.float32),
@@ -358,6 +360,7 @@ class TransactionGenerator:
             high_risk_payment=np.zeros(n, bool),  # basic methods are low-risk
             suspicious_user_agent=rng.random(n) < 0.01,
             private_ip=private_ip,
+            has_txn_fingerprint=np.ones(n, bool),
             ip_risk=np.where(private_ip, 0.1, 0.3).astype(np.float32),
             prior_fraud_score=fraud_score.astype(np.float32),
             has_user=np.ones(n, bool),

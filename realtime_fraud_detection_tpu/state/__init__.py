@@ -1,0 +1,11 @@
+from realtime_fraud_detection_tpu.state.stores import (  # noqa: F401
+    VelocityStore,
+    ProfileStore,
+    TransactionCache,
+    AggregationStore,
+    StateBackend,
+)
+from realtime_fraud_detection_tpu.state.history import (  # noqa: F401
+    UserHistoryStore,
+    EntityGraphStore,
+)

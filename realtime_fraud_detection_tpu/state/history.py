@@ -1,0 +1,129 @@
+"""Per-entity history: sequence ring buffers and the user-merchant graph.
+
+The reference keeps a last-100 transaction list per user in Redis
+(RedisService.java:296-306) and rebuilds an entity graph from it per request
+(graph_neural_network.py:244-315). Here the histories live host-side in
+pre-allocated NumPy rings so a whole microbatch gathers into dense
+``(B, T, F)`` / neighbor tensors with zero Python-per-row work on the
+device path:
+
+- ``UserHistoryStore``: fixed (T, F) float ring per user -> LSTM input
+  (sequence_length 10, config.py:151-157).
+- ``EntityGraphStore``: bounded neighbor rings user<->merchant -> GraphSAGE
+  neighbor sampling (fan-out K per hop).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Sequence, Tuple
+
+import numpy as np
+
+
+class UserHistoryStore:
+    """Ring buffer of recent feature vectors per user."""
+
+    def __init__(self, seq_len: int = 10, feature_dim: int = 64):
+        self.seq_len = seq_len
+        self.feature_dim = feature_dim
+        self._rings: Dict[str, np.ndarray] = {}
+        self._count: Dict[str, int] = {}
+
+    def append_batch(self, user_ids: Sequence[str], features: np.ndarray) -> None:
+        """Append one feature row per user (features: [B, F])."""
+        for i, uid in enumerate(user_ids):
+            ring = self._rings.get(uid)
+            if ring is None:
+                ring = np.zeros((self.seq_len, self.feature_dim), np.float32)
+                self._rings[uid] = ring
+                self._count[uid] = 0
+            pos = self._count[uid] % self.seq_len
+            ring[pos] = features[i]
+            self._count[uid] += 1
+
+    def append_and_gather(
+        self, user_ids: Sequence[str], features: np.ndarray
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Per row, in order: append the row, then gather that user's state.
+
+        This is the scoring-time semantic — each transaction is scored
+        against a history that ends with itself. A plain append_batch +
+        gather would pair earlier rows with sequences containing later
+        transactions of the same user (training-label leakage / mismatch).
+        """
+        b = len(user_ids)
+        out = np.zeros((b, self.seq_len, self.feature_dim), np.float32)
+        lengths = np.zeros((b,), np.int32)
+        for i, uid in enumerate(user_ids):
+            self.append_batch([uid], features[i : i + 1])
+            seq, ln = self.gather([uid])
+            out[i] = seq[0]
+            lengths[i] = ln[0]
+        return out, lengths
+
+    def gather(self, user_ids: Sequence[str]) -> Tuple[np.ndarray, np.ndarray]:
+        """Dense (B, T, F) history batch, oldest-first, plus lengths (B,).
+
+        Users with fewer than T events are zero-padded at the FRONT so the
+        most recent event is always the last step (what an LSTM reads out).
+        """
+        b = len(user_ids)
+        out = np.zeros((b, self.seq_len, self.feature_dim), np.float32)
+        lengths = np.zeros((b,), np.int32)
+        for i, uid in enumerate(user_ids):
+            ring = self._rings.get(uid)
+            if ring is None:
+                continue
+            count = self._count[uid]
+            k = min(count, self.seq_len)
+            pos = count % self.seq_len
+            # ring unrolled oldest->newest
+            ordered = np.concatenate([ring[pos:], ring[:pos]], axis=0) if count >= self.seq_len \
+                else ring[:k]
+            out[i, self.seq_len - k:] = ordered[-k:]
+            lengths[i] = k
+        return out, lengths
+
+    def __len__(self) -> int:
+        return len(self._rings)
+
+
+class EntityGraphStore:
+    """Bounded bipartite adjacency between users and merchants.
+
+    Node ids are the integer pool indices (sim.UserPool / sim.MerchantPool
+    order or any stable external mapping). Each side keeps a ring of its K
+    most recent counterparties; sampling pads with -1 and returns a mask.
+    """
+
+    def __init__(self, fanout: int = 16):
+        self.fanout = fanout
+        self._user_adj: Dict[int, List[int]] = {}
+        self._merchant_adj: Dict[int, List[int]] = {}
+
+    def add_edges(self, user_idx: Iterable[int], merchant_idx: Iterable[int]) -> None:
+        for u, m in zip(user_idx, merchant_idx):
+            u, m = int(u), int(m)
+            ua = self._user_adj.setdefault(u, [])
+            ua.append(m)
+            del ua[:-self.fanout]
+            ma = self._merchant_adj.setdefault(m, [])
+            ma.append(u)
+            del ma[:-self.fanout]
+
+    def _sample(self, adj: Dict[int, List[int]], ids: Sequence[int]) -> Tuple[np.ndarray, np.ndarray]:
+        b, k = len(ids), self.fanout
+        out = np.full((b, k), -1, np.int32)
+        for i, n in enumerate(ids):
+            neigh = adj.get(int(n))
+            if neigh:
+                out[i, : len(neigh)] = neigh[-k:]
+        return out, out >= 0
+
+    def user_neighbors(self, user_idx: Sequence[int]) -> Tuple[np.ndarray, np.ndarray]:
+        """Merchant neighbors of users -> (idx [B,K], mask [B,K])."""
+        return self._sample(self._user_adj, user_idx)
+
+    def merchant_neighbors(self, merchant_idx: Sequence[int]) -> Tuple[np.ndarray, np.ndarray]:
+        """User neighbors of merchants -> (idx [B,K], mask [B,K])."""
+        return self._sample(self._merchant_adj, merchant_idx)

@@ -1,0 +1,222 @@
+"""Windowed state stores — the Redis data plane, in-process.
+
+Mirrors the reference's Redis key schema (RedisService.java:36-49):
+``user:{id}`` / ``merchant:{id}`` profile hashes, ``transaction:{id}`` cache
+(TTL 24h), ``user_transactions:{id}`` last-100 list, ``velocity:{user}:
+{5min|1hour|24hour}`` counters, ``agg:{key}`` aggregations — plus the sink's
+update logic (RedisTransactionSink.java:87-262).
+
+Two defects of the reference are fixed by design:
+
+1. **RMW races** (SURVEY.md 5.2): the reference GET-then-SETs velocity and
+   aggregation values from 12 parallel Flink subtasks. Here every store
+   mutation happens on the single ingest thread that owns the key range
+   (single-writer-per-key); stores are plain dicts with no locks to contend.
+2. **Velocity TTL bug**: the reference gives all three windows a 1-hour key
+   TTL (RedisService.java:178-207), so its "24hour" window silently resets
+   after an hour of inactivity. Here each window resets on its own period.
+
+A Redis-backed implementation can slot behind ``StateBackend`` when the
+``redis`` client is available; this process-local backend is the default and
+the one the TPU scorer uses (state lives with the microbatcher, not across a
+network hop in the hot loop).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Iterable, List, Mapping, Optional, Protocol, Tuple
+
+VELOCITY_WINDOWS: dict[str, float] = {"5min": 300.0, "1hour": 3600.0, "24hour": 86400.0}
+
+
+class StateBackend(Protocol):
+    """Minimal protocol all state stores are built over."""
+
+    def get(self, key: str) -> Any: ...
+    def put(self, key: str, value: Any, ttl_s: float | None = None) -> None: ...
+    def delete(self, key: str) -> None: ...
+
+
+class _MemoryBackend:
+    """Dict backend with lazy TTL expiry (single-writer discipline)."""
+
+    def __init__(self) -> None:
+        self._data: Dict[str, Tuple[Any, float | None]] = {}
+
+    def get(self, key: str, now: float | None = None) -> Any:
+        item = self._data.get(key)
+        if item is None:
+            return None
+        value, expires = item
+        if expires is not None and (now if now is not None else time.time()) >= expires:
+            del self._data[key]
+            return None
+        return value
+
+    def put(self, key: str, value: Any, ttl_s: float | None = None,
+            now: float | None = None) -> None:
+        expires = None
+        if ttl_s is not None:
+            expires = (now if now is not None else time.time()) + ttl_s
+        self._data[key] = (value, expires)
+
+    def delete(self, key: str) -> None:
+        self._data.pop(key, None)
+
+    def __len__(self) -> int:
+        return len(self._data)
+
+
+class VelocityStore:
+    """Per-user transaction velocity over 5min/1hour/24hour windows.
+
+    Update semantics follow RedisTransactionSink.updateVelocityWindow
+    (:116-135): read current (count, amount), add, store — except each window
+    resets when its own period has elapsed since the window started.
+    """
+
+    def __init__(self) -> None:
+        # (user_id, window) -> [count, amount, window_start]
+        self._state: Dict[Tuple[str, str], List[float]] = {}
+
+    def update(self, user_id: str, amount: float, now: float) -> None:
+        for window, period in VELOCITY_WINDOWS.items():
+            key = (user_id, window)
+            cur = self._state.get(key)
+            if cur is None or now - cur[2] >= period:
+                self._state[key] = [1, amount, now]
+            else:
+                cur[0] += 1
+                cur[1] += amount
+
+    def update_batch(self, user_ids: Iterable[str], amounts: Iterable[float],
+                     now: float) -> None:
+        for uid, amt in zip(user_ids, amounts):
+            self.update(uid, float(amt), now)
+
+    def get(self, user_id: str, window: str, now: float | None = None) -> Dict[str, float]:
+        """Velocity metrics dict (RedisService.getVelocityMetrics shape)."""
+        cur = self._state.get((user_id, window))
+        if cur is None:
+            return {}
+        if now is not None and now - cur[2] >= VELOCITY_WINDOWS[window]:
+            return {}
+        return {"count": cur[0], "amount": cur[1], "timestamp": cur[2]}
+
+    def get_all(self, user_id: str, now: float | None = None) -> Dict[str, Dict[str, float]]:
+        return {w: self.get(user_id, w, now) for w in VELOCITY_WINDOWS}
+
+    def __len__(self) -> int:
+        return len(self._state)
+
+
+class ProfileStore:
+    """User + merchant profile store (``user:{id}`` / ``merchant:{id}``)."""
+
+    def __init__(self) -> None:
+        self.users: Dict[str, Mapping[str, Any]] = {}
+        self.merchants: Dict[str, Mapping[str, Any]] = {}
+
+    def seed(self, users: Mapping[str, Mapping[str, Any]] | None = None,
+             merchants: Mapping[str, Mapping[str, Any]] | None = None) -> None:
+        """Bulk-load profiles (the simulator's Redis seeding path,
+        simulator.py:243-294)."""
+        if users:
+            self.users.update(users)
+        if merchants:
+            self.merchants.update(merchants)
+
+    def get_user(self, user_id: str) -> Optional[Mapping[str, Any]]:
+        return self.users.get(user_id)
+
+    def get_merchant(self, merchant_id: str) -> Optional[Mapping[str, Any]]:
+        return self.merchants.get(merchant_id)
+
+    def put_user(self, user_id: str, profile: Mapping[str, Any]) -> None:
+        self.users[user_id] = profile
+
+    def put_merchant(self, merchant_id: str, profile: Mapping[str, Any]) -> None:
+        self.merchants[merchant_id] = profile
+
+
+class TransactionCache:
+    """Recent transactions + per-entity id lists (RedisService.java:127-171,
+    296-321): ``transaction:{id}`` TTL 24h, ``user_transactions`` last-100,
+    ``merchant_transactions`` last-500, ``features:{id}`` TTL 2h.
+    """
+
+    def __init__(self, txn_ttl_s: float = 24 * 3600, features_ttl_s: float = 2 * 3600,
+                 user_list_len: int = 100, merchant_list_len: int = 500) -> None:
+        self._backend = _MemoryBackend()
+        self.txn_ttl_s = txn_ttl_s
+        self.features_ttl_s = features_ttl_s
+        self.user_list_len = user_list_len
+        self.merchant_list_len = merchant_list_len
+        self._user_lists: Dict[str, List[str]] = {}
+        self._merchant_lists: Dict[str, List[str]] = {}
+
+    def cache_transaction(self, txn: Mapping[str, Any], now: float | None = None) -> None:
+        tid = str(txn.get("transaction_id"))
+        self._backend.put(f"transaction:{tid}", dict(txn), self.txn_ttl_s, now)
+        uid, mid = str(txn.get("user_id")), str(txn.get("merchant_id"))
+        ul = self._user_lists.setdefault(uid, [])
+        ul.insert(0, tid)
+        del ul[self.user_list_len:]
+        ml = self._merchant_lists.setdefault(mid, [])
+        ml.insert(0, tid)
+        del ml[self.merchant_list_len:]
+
+    def get_transaction(self, txn_id: str, now: float | None = None) -> Any:
+        return self._backend.get(f"transaction:{txn_id}", now)
+
+    def store_features(self, txn_id: str, features: Any, now: float | None = None) -> None:
+        self._backend.put(f"features:{txn_id}", features, self.features_ttl_s, now)
+
+    def get_features(self, txn_id: str, now: float | None = None) -> Any:
+        return self._backend.get(f"features:{txn_id}", now)
+
+    def get_user_transactions(self, user_id: str, limit: int = 100) -> List[str]:
+        return self._user_lists.get(user_id, [])[:limit]
+
+    def get_merchant_transactions(self, merchant_id: str, limit: int = 500) -> List[str]:
+        return self._merchant_lists.get(merchant_id, [])[:limit]
+
+
+class AggregationStore:
+    """Hourly / daily / per-merchant rolling aggregations
+    (RedisTransactionSink.java:140-262): total_count, total_amount,
+    fraud_count, high_risk_count, fraud_rate, avg_amount per bucket.
+    """
+
+    def __init__(self, ttl_s: float = 1800.0) -> None:
+        self._backend = _MemoryBackend()
+        self.ttl_s = ttl_s
+
+    def record(self, txn: Mapping[str, Any], now: float | None = None) -> None:
+        ts_ms = float(txn.get("timestamp_ms", (now if now is not None else time.time()) * 1000))
+        hour_key = int(ts_ms // 3_600_000)
+        day_key = int(ts_ms // 86_400_000)
+        amount = float(txn.get("amount", 0.0))
+        is_fraud = bool(txn.get("is_fraud", False))
+        high_risk = float(txn.get("fraud_score", 0.0)) > 0.7
+        for key in (f"hourly:{hour_key}", f"daily:{day_key}",
+                    f"merchant:{txn.get('merchant_id')}:{hour_key}"):
+            self._update(key, amount, is_fraud, high_risk, now)
+
+    def _update(self, key: str, amount: float, is_fraud: bool, high_risk: bool,
+                now: float | None) -> None:
+        agg = self._backend.get(f"agg:{key}", now) or {
+            "total_count": 0, "total_amount": 0.0, "fraud_count": 0,
+            "high_risk_count": 0,
+        }
+        agg["total_count"] += 1
+        agg["total_amount"] += amount
+        agg["fraud_count"] += int(is_fraud)
+        agg["high_risk_count"] += int(high_risk)
+        agg["fraud_rate"] = agg["fraud_count"] / agg["total_count"]
+        agg["avg_amount"] = agg["total_amount"] / agg["total_count"]
+        self._backend.put(f"agg:{key}", agg, self.ttl_s, now)
+
+    def get(self, key: str, now: float | None = None) -> Dict[str, Any]:
+        return self._backend.get(f"agg:{key}", now) or {}

@@ -1,0 +1,195 @@
+"""Neural branch training: LSTM and GraphSAGE on simulated streams.
+
+The reference ships no trainer for its LSTM/BERT/GNN despite the docstring
+claim (model_trainer.py:2-4 vs SURVEY.md 3.5), so this fills the gap: a
+single optax BCE loop plus dataset builders that replay the simulator stream
+through the state stores to produce real sequential/graph supervision —
+per-user histories feed the LSTM exactly the way serving will
+(state.UserHistoryStore), and the user-merchant graph grows edge-by-edge
+(state.EntityGraphStore).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from realtime_fraud_detection_tpu.features.extract import extract_features
+from realtime_fraud_detection_tpu.models.gnn import (
+    build_node_features,
+    gather_neighbor_features,
+    gnn_logits,
+    init_gnn_params,
+)
+from realtime_fraud_detection_tpu.models.lstm import init_lstm_params, lstm_logits
+from realtime_fraud_detection_tpu.state.history import EntityGraphStore, UserHistoryStore
+
+
+def bce_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    return optax.sigmoid_binary_cross_entropy(logits, labels).mean()
+
+
+@dataclasses.dataclass
+class NeuralTrainer:
+    """Minibatch BCE training loop shared by the LSTM and GNN branches."""
+
+    learning_rate: float = 1e-3
+    batch_size: int = 256
+    epochs: int = 3
+    seed: int = 0
+
+    def train(
+        self,
+        params: Dict[str, jax.Array],
+        loss_fn: Callable[[Dict[str, jax.Array], Tuple, jax.Array], jax.Array],
+        inputs: Tuple[np.ndarray, ...],
+        labels: np.ndarray,
+    ) -> Dict[str, jax.Array]:
+        tx = optax.adam(self.learning_rate)
+        opt_state = tx.init(params)
+
+        @jax.jit
+        def step(params, opt_state, batch_inputs, batch_labels):
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch_inputs, batch_labels)
+            updates, opt_state = tx.update(grads, opt_state)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        n = len(labels)
+        rng = np.random.default_rng(self.seed)
+        bs = min(self.batch_size, n)
+        for _ in range(self.epochs):
+            order = rng.permutation(n)
+            for start in range(0, n - bs + 1, bs):
+                idx = order[start : start + bs]
+                batch_inputs = tuple(a[idx] for a in inputs)
+                batch_labels = jnp.asarray(labels[idx], jnp.float32)
+                params, opt_state, _ = step(params, opt_state, batch_inputs, batch_labels)
+        return params
+
+
+# --------------------------------------------------------------------------
+# dataset builders
+# --------------------------------------------------------------------------
+
+def build_sequence_dataset(
+    generator,
+    n_transactions: int,
+    seq_len: int = 10,
+    feature_dim: int = 64,
+    chunk: int = 4096,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Replay a stream through UserHistoryStore -> (sequences, lengths, labels).
+
+    The label of a sequence is the fraud label of its most recent step — the
+    LSTM scores "is the txn that just arrived fraudulent given the user's
+    recent history" (reference sequence_length 10, config.py:151-157).
+    """
+    store = UserHistoryStore(seq_len=seq_len, feature_dim=feature_dim)
+    seqs, lens, labels = [], [], []
+    remaining = n_transactions
+    while remaining > 0:
+        b = min(chunk, remaining)
+        remaining -= b
+        batch, lab = generator.generate_encoded(b)
+        # the serving-side clip (ensemble_predictor.py:248) keeps neural
+        # inputs in a trainable range; raw amounts/velocities reach 1e4
+        feats = np.clip(np.asarray(extract_features(batch)), -10, 10)
+        user_ids = [str(generator.users.ids[i]) for i in lab["user_index"]]
+        s, l = store.append_and_gather(user_ids, feats)
+        seqs.append(s)
+        lens.append(l)
+        labels.append(lab["is_fraud"])
+    return (
+        np.concatenate(seqs, axis=0),
+        np.concatenate(lens, axis=0),
+        np.concatenate(labels, axis=0).astype(np.float32),
+    )
+
+
+def build_graph_dataset(
+    generator,
+    n_transactions: int,
+    fanout: int = 16,
+    node_dim: int = 16,
+    chunk: int = 512,
+):
+    """Replay a stream through EntityGraphStore -> GNN training tensors.
+
+    Edges are committed per chunk, so a chunk's samples see only edges from
+    earlier chunks (no label leakage through the current batch); the chunk
+    is kept small so neighborhoods actually populate.
+    """
+    graph = EntityGraphStore(fanout=fanout)
+    user_table, merchant_table = build_node_features(
+        generator.users, generator.merchants, node_dim
+    )
+    txn_f, uf, mf, unf, unm, mnf, mnm, labels = [], [], [], [], [], [], [], []
+    remaining = n_transactions
+    while remaining > 0:
+        b = min(chunk, remaining)
+        remaining -= b
+        batch, lab = generator.generate_encoded(b)
+        feats = np.clip(np.asarray(extract_features(batch)), -10, 10)
+        u_idx, m_idx = lab["user_index"], lab["merchant_index"]
+        un, un_mask = graph.user_neighbors(u_idx)
+        mn, mn_mask = graph.merchant_neighbors(m_idx)
+        txn_f.append(feats)
+        uf.append(user_table[u_idx])
+        mf.append(merchant_table[m_idx])
+        unf.append(gather_neighbor_features(merchant_table, un, un_mask))
+        unm.append(un_mask)
+        mnf.append(gather_neighbor_features(user_table, mn, mn_mask))
+        mnm.append(mn_mask)
+        labels.append(lab["is_fraud"])
+        graph.add_edges(u_idx, m_idx)  # edges visible to FUTURE batches only
+    cat = lambda xs: np.concatenate(xs, axis=0)  # noqa: E731
+    return (
+        (cat(txn_f), cat(uf), cat(mf), cat(unf), cat(unm), cat(mnf), cat(mnm)),
+        cat(labels).astype(np.float32),
+        (user_table, merchant_table, graph),
+    )
+
+
+# --------------------------------------------------------------------------
+# convenience end-to-end trainers
+# --------------------------------------------------------------------------
+
+def train_lstm(
+    generator, n_transactions: int = 50_000, seq_len: int = 10,
+    hidden: int = 128, epochs: int = 3, seed: int = 0,
+) -> Dict[str, jax.Array]:
+    seqs, lens, labels = build_sequence_dataset(generator, n_transactions, seq_len)
+    params = init_lstm_params(jax.random.PRNGKey(seed), seqs.shape[-1], hidden)
+
+    def loss_fn(p, inputs, y):
+        s, l = inputs
+        return bce_loss(lstm_logits(p, s, l), y)
+
+    return NeuralTrainer(epochs=epochs, seed=seed).train(
+        params, loss_fn, (seqs, lens), labels
+    )
+
+
+def train_gnn(
+    generator, n_transactions: int = 50_000, fanout: int = 16,
+    node_dim: int = 16, hidden: int = 64, epochs: int = 3, seed: int = 0,
+):
+    inputs, labels, (user_table, merchant_table, graph) = build_graph_dataset(
+        generator, n_transactions, fanout, node_dim
+    )
+    params = init_gnn_params(
+        jax.random.PRNGKey(seed), node_dim, inputs[0].shape[-1], hidden
+    )
+
+    def loss_fn(p, batch_inputs, y):
+        return bce_loss(gnn_logits(p, *batch_inputs), y)
+
+    params = NeuralTrainer(epochs=epochs, seed=seed).train(
+        params, loss_fn, inputs, labels
+    )
+    return params, user_table, merchant_table, graph

@@ -270,3 +270,32 @@ class TestServingProcessor:
         mat = proc.to_model_matrix(rows)
         assert mat.shape[1] >= 64
         assert mat.max() <= 10.0 and mat.min() >= -10.0
+
+
+class TestReviewRegressions:
+    def test_no_device_fingerprint_no_penalty(self):
+        # TransactionProcessor.java:252-262: rule fires only when the txn
+        # carries a fingerprint that is unknown
+        txn_nofp = dict(TXN)
+        del txn_nofp["device_fingerprint"]
+        txn_badfp = dict(TXN, device_fingerprint="stranger-device")
+        batch = encode_transactions(
+            [txn_nofp, txn_badfp, TXN], {"user_a": USER}, {"merchant_a": MERCHANT}
+        )
+        scores = np.asarray(rule_score(batch))
+        assert scores[1] == pytest.approx(scores[0] + 0.1, abs=1e-6)  # penalty
+        assert scores[2] == pytest.approx(scores[0], abs=1e-6)  # known device
+
+    def test_negative_amount_features_finite(self):
+        txn = dict(TXN, amount=-20.0, transaction_type="refund")
+        batch = encode_transactions([txn], {"user_a": USER}, {"merchant_a": MERCHANT})
+        feats = np.asarray(extract_features(batch))
+        assert np.isfinite(feats).all()
+
+    def test_fast_path_day_of_month_matches_clock(self):
+        from realtime_fraud_detection_tpu.sim import TransactionGenerator
+
+        gen = TransactionGenerator(num_users=10, num_merchants=5, seed=0)
+        day0 = gen.clock.day
+        batch, _ = gen.generate_encoded(4)
+        assert int(np.asarray(batch.day_of_month)[0]) == day0

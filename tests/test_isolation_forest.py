@@ -55,3 +55,16 @@ class TestIsolationForest:
         a = IsolationForestTrainer(n_estimators=10, seed=7).fit(normal)
         b = IsolationForestTrainer(n_estimators=10, seed=7).fit(normal)
         np.testing.assert_array_equal(np.asarray(a.threshold), np.asarray(b.threshold))
+
+
+class TestEmptyChildRegression:
+    def test_constant_feature_columns_no_crash(self):
+        # constant / near-constant features force degenerate splits
+        rng = np.random.default_rng(0)
+        x = np.zeros((300, 6), np.float32)
+        x[:, 0] = rng.normal(size=300)          # one informative column
+        x[:, 1] = 7.0                            # constant
+        x[:, 2] = np.repeat([1.0, 1.0 + 1e-7], 150)  # ulp-scale spread
+        forest = IsolationForestTrainer(n_estimators=30, seed=3).fit(x)
+        s = np.asarray(iforest_scores(forest, x[:50]))
+        assert np.isfinite(s).all()

@@ -1,0 +1,134 @@
+"""State store tests: velocity windows, caches, history rings, graph."""
+
+import numpy as np
+
+from realtime_fraud_detection_tpu.state import (
+    AggregationStore,
+    EntityGraphStore,
+    ProfileStore,
+    TransactionCache,
+    UserHistoryStore,
+    VelocityStore,
+)
+
+
+class TestVelocityStore:
+    def test_accumulates_within_window(self):
+        v = VelocityStore()
+        v.update("u1", 10.0, now=1000.0)
+        v.update("u1", 20.0, now=1100.0)
+        m = v.get("u1", "5min", now=1150.0)
+        assert m["count"] == 2 and m["amount"] == 30.0
+
+    def test_five_minute_window_resets(self):
+        v = VelocityStore()
+        v.update("u1", 10.0, now=1000.0)
+        v.update("u1", 20.0, now=1000.0 + 301)  # past 5min
+        m5 = v.get("u1", "5min")
+        m1h = v.get("u1", "1hour")
+        assert m5["count"] == 1 and m5["amount"] == 20.0
+        assert m1h["count"] == 2  # 1h window still accumulating
+
+    def test_24h_window_outlives_an_hour(self):
+        # the reference's 1h TTL on all windows is a bug; ours must not reset
+        v = VelocityStore()
+        v.update("u1", 10.0, now=0.0)
+        v.update("u1", 10.0, now=7200.0)  # 2h later
+        assert v.get("u1", "24hour")["count"] == 2
+        assert v.get("u1", "1hour")["count"] == 1  # 1h window did reset
+
+    def test_expired_read(self):
+        v = VelocityStore()
+        v.update("u1", 10.0, now=0.0)
+        assert v.get("u1", "5min", now=1000.0) == {}
+
+    def test_unknown_user_empty(self):
+        assert VelocityStore().get_all("nobody") == {"5min": {}, "1hour": {}, "24hour": {}}
+
+
+class TestTransactionCache:
+    def test_ttl_expiry(self):
+        c = TransactionCache(txn_ttl_s=100)
+        c.cache_transaction({"transaction_id": "t1", "user_id": "u", "merchant_id": "m"}, now=0)
+        assert c.get_transaction("t1", now=50) is not None
+        assert c.get_transaction("t1", now=150) is None
+
+    def test_user_list_bounded_lifo(self):
+        c = TransactionCache(user_list_len=3)
+        for i in range(5):
+            c.cache_transaction(
+                {"transaction_id": f"t{i}", "user_id": "u", "merchant_id": "m"}, now=0
+            )
+        assert c.get_user_transactions("u") == ["t4", "t3", "t2"]
+
+    def test_features_ttl(self):
+        c = TransactionCache(features_ttl_s=10)
+        c.store_features("t1", [1.0, 2.0], now=0)
+        assert c.get_features("t1", now=5) == [1.0, 2.0]
+        assert c.get_features("t1", now=11) is None
+
+
+class TestAggregationStore:
+    def test_hourly_rollup(self):
+        a = AggregationStore()
+        ts = 3_600_000 * 10  # hour bucket 10
+        a.record({"timestamp_ms": ts, "amount": 100.0, "is_fraud": True,
+                  "fraud_score": 0.9, "merchant_id": "m1"}, now=0)
+        a.record({"timestamp_ms": ts + 1000, "amount": 50.0, "is_fraud": False,
+                  "fraud_score": 0.1, "merchant_id": "m1"}, now=0)
+        agg = a.get("hourly:10", now=0)
+        assert agg["total_count"] == 2
+        assert agg["total_amount"] == 150.0
+        assert agg["fraud_rate"] == 0.5
+        assert agg["high_risk_count"] == 1
+        assert a.get("merchant:m1:10", now=0)["total_count"] == 2
+
+
+class TestUserHistoryStore:
+    def test_front_padding_and_order(self):
+        s = UserHistoryStore(seq_len=4, feature_dim=2)
+        for i in range(3):
+            s.append_batch(["u1"], np.array([[i + 1.0, 0.0]], np.float32))
+        seqs, lengths = s.gather(["u1", "u2"])
+        assert seqs.shape == (2, 4, 2)
+        assert lengths.tolist() == [3, 0]
+        # front-padded: [0, 1, 2, 3] with most recent last
+        np.testing.assert_array_equal(seqs[0, :, 0], [0.0, 1.0, 2.0, 3.0])
+        assert (seqs[1] == 0).all()
+
+    def test_ring_wraps_keeping_latest(self):
+        s = UserHistoryStore(seq_len=3, feature_dim=1)
+        for i in range(7):
+            s.append_batch(["u"], np.array([[float(i)]], np.float32))
+        seqs, lengths = s.gather(["u"])
+        assert lengths[0] == 3
+        np.testing.assert_array_equal(seqs[0, :, 0], [4.0, 5.0, 6.0])
+
+
+class TestEntityGraphStore:
+    def test_neighbor_sampling_and_masks(self):
+        g = EntityGraphStore(fanout=3)
+        g.add_edges([1, 1, 2], [10, 11, 10])
+        idx, mask = g.user_neighbors([1, 2, 3])
+        assert idx.shape == (3, 3)
+        assert set(idx[0][mask[0]]) == {10, 11}
+        assert set(idx[1][mask[1]]) == {10}
+        assert not mask[2].any()  # unseen user
+        ridx, rmask = g.merchant_neighbors([10])
+        assert set(ridx[0][rmask[0]]) == {1, 2}
+
+    def test_fanout_bounded(self):
+        g = EntityGraphStore(fanout=2)
+        g.add_edges([1] * 5, [10, 11, 12, 13, 14])
+        idx, mask = g.user_neighbors([1])
+        assert mask[0].sum() == 2
+        assert set(idx[0][mask[0]]) == {13, 14}  # most recent kept
+
+
+class TestProfileStore:
+    def test_seed_and_get(self):
+        p = ProfileStore()
+        p.seed({"u1": {"risk_score": 0.2}}, {"m1": {"category": "retail"}})
+        assert p.get_user("u1")["risk_score"] == 0.2
+        assert p.get_merchant("m1")["category"] == "retail"
+        assert p.get_user("nope") is None
