@@ -1,0 +1,164 @@
+"""DistilBERT-style text encoder in pure JAX with blockwise attention.
+
+The reference's ``bert_text`` branch is a DistilBERT sequence classifier
+(config.py:165-170: distilbert-base-uncased, 2 labels; served path stubbed
+random at model_manager.py:332-336; the real torch path lives in
+bert_text_analyzer.py:179-226). This is the architecture rebuilt TPU-first:
+
+- standard DistilBERT shape: 6 post-LN layers, 12 heads, hidden 768,
+  GELU FFN 3072, learned positions, LayerNorm'd embeddings;
+- attention runs through the Pallas blockwise kernel (ops/attention.py) on
+  TPU, falling back to the XLA reference implementation elsewhere;
+- classification head = pre_classifier(768->768, ReLU) -> classifier(768->2)
+  on the [CLS] token, exactly DistilBertForSequenceClassification's head;
+- bf16 matmuls / f32 layernorm+softmax per the precision policy.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from realtime_fraud_detection_tpu.ops.attention import (
+    attention_reference,
+    flash_attention,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 6
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    layer_norm_eps: float = 1e-12
+    num_labels: int = 2
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+TINY_CONFIG = BertConfig(hidden_size=128, num_layers=2, num_heads=2,
+                         intermediate_size=256, vocab_size=30522)
+
+
+def init_bert_params(key: jax.Array, config: BertConfig) -> Dict:
+    """Truncated-normal(0.02) init, matching BERT convention."""
+    h, ffn = config.hidden_size, config.intermediate_size
+
+    def dense(k, shape):
+        return {
+            "w": jax.random.truncated_normal(k, -2, 2, shape, jnp.float32) * 0.02,
+            "b": jnp.zeros((shape[-1],), jnp.float32),
+        }
+
+    def ln():
+        return {"scale": jnp.ones((h,), jnp.float32), "bias": jnp.zeros((h,), jnp.float32)}
+
+    keys = jax.random.split(key, 3 + 6 * config.num_layers)
+    params: Dict = {
+        "word_emb": jax.random.truncated_normal(
+            keys[0], -2, 2, (config.vocab_size, h), jnp.float32) * 0.02,
+        "pos_emb": jax.random.truncated_normal(
+            keys[1], -2, 2, (config.max_position_embeddings, h), jnp.float32) * 0.02,
+        "emb_ln": ln(),
+        "layers": [],
+        "pre_classifier": dense(keys[2], (h, h)),
+    }
+    for i in range(config.num_layers):
+        k = keys[3 + 6 * i : 9 + 6 * i]
+        params["layers"].append({
+            "q": dense(k[0], (h, h)),
+            "k": dense(k[1], (h, h)),
+            "v": dense(k[2], (h, h)),
+            "o": dense(k[3], (h, h)),
+            "attn_ln": ln(),
+            "ffn1": dense(k[4], (h, ffn)),
+            "ffn2": dense(k[5], (ffn, h)),
+            "ffn_ln": ln(),
+        })
+    params["classifier"] = dense(
+        jax.random.fold_in(keys[2], 7), (h, config.num_labels)
+    )
+    return params
+
+
+def _layer_norm(x, p, eps):
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(axis=-1, keepdims=True)
+    var = ((x32 - mu) ** 2).mean(axis=-1, keepdims=True)
+    return ((x32 - mu) * jax.lax.rsqrt(var + eps) * p["scale"] + p["bias"])
+
+
+def _dense(x, p, compute_dtype):
+    return x.astype(compute_dtype) @ p["w"].astype(compute_dtype) + p["b"]
+
+
+def bert_encode(
+    params: Dict,
+    input_ids: jax.Array,       # i32[B, S]
+    attention_mask: jax.Array,  # bool[B, S]
+    config: BertConfig,
+    use_pallas: bool = False,
+    compute_dtype=jnp.bfloat16,
+) -> jax.Array:
+    """Hidden states f32[B, S, H]."""
+    b, s = input_ids.shape
+    x = params["word_emb"][input_ids] + params["pos_emb"][:s][None, :, :]
+    x = _layer_norm(x, params["emb_ln"], config.layer_norm_eps)
+
+    for layer in params["layers"]:
+        q = _dense(x, layer["q"], compute_dtype)
+        k = _dense(x, layer["k"], compute_dtype)
+        v = _dense(x, layer["v"], compute_dtype)
+
+        def split(t):
+            return t.reshape(b, s, config.num_heads, config.head_dim).transpose(0, 2, 1, 3)
+
+        qh, kh, vh = split(q), split(k), split(v)
+        if use_pallas:
+            ctx = flash_attention(qh, kh, vh, attention_mask)
+        else:
+            ctx = attention_reference(qh, kh, vh, attention_mask)
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, config.hidden_size)
+        attn_out = _dense(ctx, layer["o"], compute_dtype)
+        x = _layer_norm(x + attn_out, layer["attn_ln"], config.layer_norm_eps)
+
+        ffn = _dense(jax.nn.gelu(_dense(x, layer["ffn1"], compute_dtype)),
+                     layer["ffn2"], compute_dtype)
+        x = _layer_norm(x + ffn, layer["ffn_ln"], config.layer_norm_eps)
+    return x
+
+
+def bert_logits(
+    params: Dict,
+    input_ids: jax.Array,
+    attention_mask: jax.Array,
+    config: BertConfig,
+    use_pallas: bool = False,
+) -> jax.Array:
+    """Sequence-classification logits f32[B, num_labels] from [CLS]."""
+    hidden = bert_encode(params, input_ids, attention_mask, config, use_pallas)
+    cls = hidden[:, 0, :]
+    z = jax.nn.relu(cls @ params["pre_classifier"]["w"] + params["pre_classifier"]["b"])
+    return z @ params["classifier"]["w"] + params["classifier"]["b"]
+
+
+def bert_predict(
+    params: Dict,
+    input_ids: jax.Array,
+    attention_mask: jax.Array,
+    config: BertConfig,
+    use_pallas: bool = False,
+) -> jax.Array:
+    """Fraud probability f32[B] = softmax(logits)[:, 1]
+    (bert_text_analyzer.py:216-222)."""
+    logits = bert_logits(params, input_ids, attention_mask, config, use_pallas)
+    return jax.nn.softmax(logits, axis=-1)[:, 1]

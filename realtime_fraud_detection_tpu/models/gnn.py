@@ -132,8 +132,11 @@ def build_node_features(
     user nodes:   [risk, log-avg-amount, freq, age/365, verified, weekend,
                    intl, online] zero-padded to node_dim
     merchant nodes: [risk_code/2, fraud_rate, log-avg-amount, blacklisted,
-                   category/10, op_start/24, op_end/24] zero-padded.
+                   category/10, op_start/24, op_end/24] zero-padded; slot 8
+                   is the merchant type tag, so node_dim must be >= 9.
     """
+    if node_dim < 9:
+        raise ValueError(f"node_dim must be >= 9 (8 stat slots + type tag), got {node_dim}")
     u = np.zeros((user_pool.n, node_dim), np.float32)
     u[:, 0] = user_pool.risk_score
     u[:, 1] = np.log1p(user_pool.avg_amount)

@@ -1,0 +1,173 @@
+"""Transaction text analysis: BERT scoring + keyword rules + text stats.
+
+Capability mirror of ``BertTextAnalyzer`` (bert_text_analyzer.py:21-412),
+batched: where the reference runs three separate single-text BERT calls per
+transaction (merchant / description / combined, :123-143), this tokenizes
+all 3B variants into one (3B, L) batch and makes a single encoder call.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Mapping, Sequence
+
+import jax
+import numpy as np
+
+from realtime_fraud_detection_tpu.models.bert import (
+    BertConfig,
+    bert_predict,
+    init_bert_params,
+)
+from realtime_fraud_detection_tpu.models.tokenizer import FraudTokenizer
+
+from realtime_fraud_detection_tpu.models.keywords import (  # noqa: F401
+    CRYPTO_KEYWORDS,
+    GIFT_CARD_KEYWORDS,
+    SCAM_PATTERNS,
+    SUSPICIOUS_PATTERNS,
+    URGENT_KEYWORDS,
+)
+
+# Per-field weights for the overall risk (bert_text_analyzer.py:148-152)
+FIELD_WEIGHTS = {"merchant_name_risk": 0.4, "description_risk": 0.3,
+                 "combined_text_risk": 0.3}
+
+
+def combined_text(text_data: Mapping[str, str]) -> str:
+    """Combined contextual text (bert_text_analyzer.py:253-281)."""
+    parts = []
+    if text_data.get("merchant_name"):
+        parts.append(f"Merchant: {text_data['merchant_name']}")
+    if text_data.get("description"):
+        parts.append(f"Description: {text_data['description']}")
+    if text_data.get("category"):
+        parts.append(f"Category: {text_data['category']}")
+    if text_data.get("location"):
+        parts.append(f"Location: {text_data['location']}")
+    return " | ".join(parts)
+
+
+def detect_fraud_patterns(text_data: Mapping[str, str]) -> Dict[str, bool]:
+    """Rule-based keyword detection (bert_text_analyzer.py:283-344)."""
+    all_text = " ".join(
+        text_data.get(k, "") or ""
+        for k in ("merchant_name", "description", "category", "location")
+    ).lower()
+    return {
+        "crypto_keywords": any(k in all_text for k in CRYPTO_KEYWORDS),
+        "gift_card_keywords": any(k in all_text for k in GIFT_CARD_KEYWORDS),
+        "urgent_language": any(k in all_text for k in URGENT_KEYWORDS),
+        "suspicious_merchant": any(k in all_text for k in SUSPICIOUS_PATTERNS),
+        "known_scam_patterns": any(k in all_text for k in SCAM_PATTERNS),
+    }
+
+
+def get_text_features(text_data: Mapping[str, str]) -> Dict[str, float]:
+    """Numeric text statistics (bert_text_analyzer.py:346-399)."""
+    merchant = text_data.get("merchant_name", "") or ""
+    description = text_data.get("description", "") or ""
+    f: Dict[str, float] = {
+        "merchant_name_length": len(merchant),
+        "description_length": len(description),
+    }
+    f["total_text_length"] = f["merchant_name_length"] + f["description_length"]
+    if merchant:
+        f["merchant_name_unique_chars"] = len(set(merchant.lower()))
+        f["merchant_name_char_diversity"] = (
+            f["merchant_name_unique_chars"] / max(len(merchant), 1)
+        )
+    else:
+        f["merchant_name_unique_chars"] = 0
+        f["merchant_name_char_diversity"] = 0
+    f["numbers_in_merchant"] = len(re.findall(r"\d", merchant))
+    f["numbers_in_description"] = len(re.findall(r"\d", description))
+    f["total_numbers"] = f["numbers_in_merchant"] + f["numbers_in_description"]
+    f["special_chars_merchant"] = len(re.findall(r"[^a-zA-Z0-9\s]", merchant))
+    f["special_chars_description"] = len(re.findall(r"[^a-zA-Z0-9\s]", description))
+    f["total_special_chars"] = (
+        f["special_chars_merchant"] + f["special_chars_description"]
+    )
+    f["merchant_word_count"] = len(merchant.split()) if merchant else 0
+    f["description_word_count"] = len(description.split()) if description else 0
+    f["total_word_count"] = f["merchant_word_count"] + f["description_word_count"]
+    return f
+
+
+class TextAnalyzer:
+    """Batched BERT text analyzer."""
+
+    def __init__(
+        self,
+        config: BertConfig | None = None,
+        params: Dict | None = None,
+        max_length: int = 128,
+        use_pallas: bool = False,
+        seed: int = 0,
+    ):
+        self.config = config or BertConfig()
+        self.tokenizer = FraudTokenizer(self.config.vocab_size, max_length)
+        self.params = params if params is not None else init_bert_params(
+            jax.random.PRNGKey(seed), self.config
+        )
+        self.use_pallas = use_pallas
+        self.total_predictions = 0
+        self.total_time_ms = 0.0
+
+    def score_texts(self, texts: Sequence[str]) -> np.ndarray:
+        """Fraud probability per text, one encoder call. f32[N]."""
+        ids, mask = self.tokenizer.encode_batch(texts)
+        return np.asarray(
+            bert_predict(self.params, ids, mask, self.config, self.use_pallas)
+        )
+
+    def analyze_transaction_text(
+        self, batch: Sequence[Mapping[str, str]]
+    ) -> List[Dict[str, float]]:
+        """Per-transaction field risks + weighted overall
+        (bert_text_analyzer.py:104-177), batched 3B-wide."""
+        import time as _time
+
+        start = _time.time()
+        texts: List[str] = []
+        index: List[List[tuple[str, int]]] = []
+        for td in batch:
+            fields = []
+            if td.get("merchant_name"):
+                fields.append(("merchant_name_risk", len(texts)))
+                texts.append(td["merchant_name"])
+            if td.get("description"):
+                fields.append(("description_risk", len(texts)))
+                texts.append(td["description"])
+            combo = combined_text(td)
+            if combo:
+                fields.append(("combined_text_risk", len(texts)))
+                texts.append(combo)
+            index.append(fields)
+
+        scores = self.score_texts(texts) if texts else np.zeros((0,))
+        results = []
+        for fields in index:
+            res = {name: float(scores[i]) for name, i in fields}
+            if res:
+                total_w = sum(FIELD_WEIGHTS.get(n, 0.1) for n in res)
+                res["overall_text_risk"] = (
+                    sum(s * FIELD_WEIGHTS.get(n, 0.1) for n, s in res.items()) / total_w
+                    if total_w > 0 else 0.0
+                )
+            else:
+                res["overall_text_risk"] = 0.0
+            results.append(res)
+        elapsed = (_time.time() - start) * 1000
+        self.total_predictions += len(batch)
+        self.total_time_ms += elapsed
+        return results
+
+    def get_performance_stats(self) -> Dict[str, float]:
+        """(bert_text_analyzer.py:401-412)"""
+        n = self.total_predictions
+        return {
+            "total_predictions": n,
+            "avg_processing_time_ms": self.total_time_ms / n if n else 0.0,
+            "total_processing_time_ms": self.total_time_ms,
+        }

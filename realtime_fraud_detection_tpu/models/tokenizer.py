@@ -1,0 +1,72 @@
+"""Deterministic fraud-domain tokenizer.
+
+The reference loads ``distilbert-base-uncased``'s pretrained tokenizer from
+the HuggingFace hub (bert_text_analyzer.py:47-66) and falls back to a dummy
+when offline. This environment has zero egress, and the reference's served
+weights were random anyway (model_manager.py:332-336 stubs the transformers
+branch), so the framework ships its own deterministic tokenizer:
+
+- preprocessing identical to the reference (:228-251): lowercase, strip
+  non-alphanumerics, collapse whitespace;
+- a built-in fraud-domain vocabulary (every keyword the rule engine knows,
+  merchant categories, template words) with stable ids;
+- hash-bucketed OOV words (crc32 into a reserved id range) so ANY merchant
+  string tokenizes deterministically with no vocab file;
+- BERT-convention special ids: [PAD]=0, [UNK]=100, [CLS]=101, [SEP]=102.
+"""
+
+from __future__ import annotations
+
+import re
+import zlib
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+from realtime_fraud_detection_tpu.models.keywords import vocabulary_words
+
+PAD_ID, UNK_ID, CLS_ID, SEP_ID = 0, 100, 101, 102
+_WORD_ID_START = 1000
+_HASH_ID_START = 2000
+
+
+class FraudTokenizer:
+    """Whitespace word tokenizer with fixed domain vocab + hashed OOV."""
+
+    def __init__(self, vocab_size: int = 30522, max_length: int = 128):
+        self.vocab_size = vocab_size
+        self.max_length = max_length
+        self.vocab = {w: _WORD_ID_START + i for i, w in enumerate(vocabulary_words())}
+        assert _WORD_ID_START + len(self.vocab) <= _HASH_ID_START
+
+    @staticmethod
+    def preprocess(text: str) -> str:
+        """Reference preprocessing (bert_text_analyzer.py:228-251)."""
+        if not text:
+            return ""
+        text = text.strip().lower()
+        text = re.sub(r"[^a-zA-Z0-9\s]", " ", text)
+        return " ".join(text.split())
+
+    def _word_id(self, word: str) -> int:
+        wid = self.vocab.get(word)
+        if wid is not None:
+            return wid
+        span = self.vocab_size - _HASH_ID_START
+        return _HASH_ID_START + zlib.crc32(word.encode()) % span
+
+    def encode(self, text: str) -> List[int]:
+        words = self.preprocess(text).split()
+        ids = [CLS_ID] + [self._word_id(w) for w in words] + [SEP_ID]
+        return ids[: self.max_length]
+
+    def encode_batch(self, texts: Sequence[str]) -> Tuple[np.ndarray, np.ndarray]:
+        """Batch to fixed (B, max_length) ids + attention mask."""
+        b = len(texts)
+        ids = np.full((b, self.max_length), PAD_ID, np.int32)
+        mask = np.zeros((b, self.max_length), bool)
+        for i, text in enumerate(texts):
+            row = self.encode(text)
+            ids[i, : len(row)] = row
+            mask[i, : len(row)] = True
+        return ids, mask

@@ -1,0 +1,4 @@
+from realtime_fraud_detection_tpu.ops.attention import (  # noqa: F401
+    flash_attention,
+    attention_reference,
+)

@@ -1,0 +1,117 @@
+"""Blockwise (flash) attention Pallas kernel for TPU.
+
+The text branch's encoder is the only model in the system big enough to have
+a real attention cost (DistilBERT, seq 128-512). XLA's stock attention is
+fine at these sizes, but the framework keeps the kernel blockwise from day
+one (SURVEY.md 5.7): the k-loop with an online softmax is exactly the shape
+that extends to ring attention over the ``seq`` mesh axis for long-context
+work — each k-block step becomes a ring hop.
+
+Layout: q, k, v are [B, H, S, D]; ``key_mask`` is bool[B, S] marking valid
+(non-pad) keys. Grid is (B, H, S/block_q); each program owns one q block and
+streams k/v blocks through VMEM with running (max, denominator, accumulator)
+state, f32 throughout the softmax accumulation per the precision policy.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def attention_reference(
+    q: jax.Array, k: jax.Array, v: jax.Array, key_mask: jax.Array | None = None
+) -> jax.Array:
+    """Plain XLA attention (numerics oracle + CPU fallback). [B,H,S,D]."""
+    d = q.shape[-1]
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) / np.sqrt(d)
+    if key_mask is not None:
+        scores = jnp.where(key_mask[:, None, None, :], scores, NEG_INF)
+    weights = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", weights.astype(v.dtype), v)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, *, block_k: int, scale: float):
+    q = q_ref[0, 0].astype(jnp.float32) * scale          # [bq, D]
+    seq_len = k_ref.shape[2]
+    num_kb = seq_len // block_k
+    bq, d = q.shape
+
+    def body(kb, carry):
+        acc, m_prev, l_prev = carry
+        k_blk = k_ref[0, 0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, 0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        mask_blk = mask_ref[0, 0, pl.ds(kb * block_k, block_k)] > 0.0  # [bk]
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                                    # [bq, bk]
+        s = jnp.where(mask_blk[None, :], s, NEG_INF)
+        m_new = jnp.maximum(m_prev, s.max(axis=1))          # [bq]
+        alpha = jnp.exp(m_prev - m_new)                     # rescale old state
+        p = jnp.exp(s - m_new[:, None])                     # [bq, bk]
+        l_new = l_prev * alpha + p.sum(axis=1)
+        acc = acc * alpha[:, None] + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return acc, m_new, l_new
+
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    m0 = jnp.full((bq,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    acc, _, l = jax.lax.fori_loop(0, num_kb, body, (acc0, m0, l0))
+    o_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("block_q", "block_k", "interpret"))
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    key_mask: jax.Array | None = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
+    """Blockwise attention. q/k/v: [B, H, S, D] -> [B, H, S, D].
+
+    ``interpret=True`` runs the kernel through the Pallas interpreter
+    (CPU-testable); on TPU leave it False.
+    """
+    b, h, s, d = q.shape
+    if key_mask is None:
+        key_mask = jnp.ones((b, s), bool)
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    if s % block_q or s % block_k:
+        raise ValueError(f"seq len {s} must be divisible by blocks ({block_q},{block_k})")
+
+    # [B, 1, S] f32 so the mask block's trailing dims (1, S) satisfy the TPU
+    # (8, 128)-or-full tiling constraint (bool [B, S] blocks do not lower)
+    mask_f32 = key_mask.astype(jnp.float32)[:, None, :]
+
+    grid = (b, h, s // block_q)
+    kernel = functools.partial(
+        _flash_kernel, block_k=block_k, scale=1.0 / float(np.sqrt(d))
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, s), lambda bi, hi, qi: (bi, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
+        interpret=interpret,
+    )(q, k, v, mask_f32)

@@ -140,6 +140,15 @@ class MerchantPool:
             for i in range(n)
         ])
         self.suspicious_name = suspicious
+        # suspicious-named merchants really do attract more fraud
+        self.fraud_rate = np.where(
+            suspicious, np.minimum(self.fraud_rate * 3.0, 0.3), self.fraud_rate
+        ).astype(np.float32)
+        # per-merchant fraud multiplier, normalized so E[mult] == 1 over a
+        # uniform merchant draw: total stream fraud stays at the documented
+        # ~5.5% BASIC_FRAUD_MIX prevalence even after clipping
+        raw_mult = np.clip(self.fraud_rate / max(self.fraud_rate.mean(), 1e-6), 0.2, 4.0)
+        self.fraud_mult = (raw_mult / raw_mult.mean()).astype(np.float32)
 
     def profile_dict(self, i: int) -> Dict[str, Any]:
         return {
@@ -244,15 +253,19 @@ class TransactionGenerator:
             "fraud_type": None,
             "fraud_score": 0.0,
         }
-        # basic fraud mix (simulator.py:106-127,349-371)
-        roll = rng.random()
-        cum = 0.0
+        # basic fraud mix (simulator.py:106-127,349-371), modulated by the
+        # merchant's fraud rate (same rule as the fast path)
+        total_mix = sum(BASIC_FRAUD_MIX.values())
+        mult = float(self.merchants.fraud_mult[m])
         fraud_type = None
-        for name, p in BASIC_FRAUD_MIX.items():
-            cum += p
-            if roll < cum:
-                fraud_type = name
-                break
+        if rng.random() < total_mix * mult:
+            pattern_roll = rng.random() * total_mix
+            cum = 0.0
+            for name, p in BASIC_FRAUD_MIX.items():
+                cum += p
+                if pattern_roll < cum:
+                    fraud_type = name
+                    break
         if fraud_type is not None:
             txn["is_fraud"] = True
             txn["fraud_type"] = fraud_type
@@ -302,14 +315,22 @@ class TransactionGenerator:
         lat = np.where(intl, rng.uniform(-90, 90, n), up.home_lat[u] + rng.normal(0, 0.5, n))
         lon = np.where(intl, rng.uniform(-180, 180, n), up.home_lon[u] + rng.normal(0, 0.5, n))
 
-        # fraud mix
+        # fraud mix, modulated by the merchant's own fraud rate so merchant
+        # identity (category, suspicious name) carries real signal — the
+        # reference stores per-merchant fraud_rate (simulator.py:255-266)
+        # but never lets it influence label generation
         probs = np.array(list(BASIC_FRAUD_MIX.values()))
-        cum = np.concatenate([[0.0], np.cumsum(probs)])
+        total_mix = probs.sum()
+        mult = mp.fraud_mult[m]
         roll = rng.random(n)
+        is_fraud = roll < total_mix * mult
+        # pattern choice within fraud rows keeps the mix proportions
+        pattern_roll = rng.random(n) * total_mix
+        cum = np.concatenate([[0.0], np.cumsum(probs)])
         fraud_code = np.zeros(n, np.int32)  # 0 = none
         for k in range(len(probs)):
-            fraud_code[(roll >= cum[k]) & (roll < cum[k + 1])] = k + 1
-        is_fraud = fraud_code > 0
+            sel = is_fraud & (pattern_roll >= cum[k]) & (pattern_roll < cum[k + 1])
+            fraud_code[sel] = k + 1
 
         ct = fraud_code == 1 + list(BASIC_FRAUD_MIX).index("card_testing")
         ato = fraud_code == 1 + list(BASIC_FRAUD_MIX).index("account_takeover")

@@ -30,6 +30,22 @@ from typing import Any, Dict, Iterable, List, Mapping, Optional, Protocol, Tuple
 VELOCITY_WINDOWS: dict[str, float] = {"5min": 300.0, "1hour": 3600.0, "24hour": 86400.0}
 
 
+def _event_time_ms(txn: Mapping[str, Any], now: float | None) -> float:
+    """Event time in ms: explicit timestamp_ms, else the simulator's ISO
+    'timestamp' string, else wall clock / ``now``."""
+    if "timestamp_ms" in txn:
+        return float(txn["timestamp_ms"])
+    ts = txn.get("timestamp")
+    if isinstance(ts, str) and ts:
+        from datetime import datetime
+
+        try:
+            return datetime.fromisoformat(ts).timestamp() * 1000.0
+        except ValueError:
+            pass
+    return (now if now is not None else time.time()) * 1000.0
+
+
 class StateBackend(Protocol):
     """Minimal protocol all state stores are built over."""
 
@@ -79,8 +95,12 @@ class VelocityStore:
     def __init__(self) -> None:
         # (user_id, window) -> [count, amount, window_start]
         self._state: Dict[Tuple[str, str], List[float]] = {}
+        # stream time: the latest `now` any update has seen; reads that omit
+        # `now` expire against this clock (keeps virtual/sim clocks coherent)
+        self._clock: float = 0.0
 
     def update(self, user_id: str, amount: float, now: float) -> None:
+        self._clock = max(self._clock, now)
         for window, period in VELOCITY_WINDOWS.items():
             key = (user_id, window)
             cur = self._state.get(key)
@@ -96,11 +116,15 @@ class VelocityStore:
             self.update(uid, float(amt), now)
 
     def get(self, user_id: str, window: str, now: float | None = None) -> Dict[str, float]:
-        """Velocity metrics dict (RedisService.getVelocityMetrics shape)."""
+        """Velocity metrics dict (RedisService.getVelocityMetrics shape).
+
+        Expiry always applies: against ``now`` when given, else against the
+        stream clock (latest update time seen).
+        """
         cur = self._state.get((user_id, window))
         if cur is None:
             return {}
-        if now is not None and now - cur[2] >= VELOCITY_WINDOWS[window]:
+        if (now if now is not None else self._clock) - cur[2] >= VELOCITY_WINDOWS[window]:
             return {}
         return {"count": cur[0], "amount": cur[1], "timestamp": cur[2]}
 
@@ -194,7 +218,7 @@ class AggregationStore:
         self.ttl_s = ttl_s
 
     def record(self, txn: Mapping[str, Any], now: float | None = None) -> None:
-        ts_ms = float(txn.get("timestamp_ms", (now if now is not None else time.time()) * 1000))
+        ts_ms = _event_time_ms(txn, now)
         hour_key = int(ts_ms // 3_600_000)
         day_key = int(ts_ms // 86_400_000)
         amount = float(txn.get("amount", 0.0))

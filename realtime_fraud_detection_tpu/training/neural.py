@@ -36,12 +36,13 @@ def bce_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
 
 @dataclasses.dataclass
 class NeuralTrainer:
-    """Minibatch BCE training loop shared by the LSTM and GNN branches."""
+    """Minibatch training loop shared by the LSTM, GNN, and BERT branches."""
 
     learning_rate: float = 1e-3
     batch_size: int = 256
     epochs: int = 3
     seed: int = 0
+    optimizer: optax.GradientTransformation | None = None
 
     def train(
         self,
@@ -50,13 +51,13 @@ class NeuralTrainer:
         inputs: Tuple[np.ndarray, ...],
         labels: np.ndarray,
     ) -> Dict[str, jax.Array]:
-        tx = optax.adam(self.learning_rate)
+        tx = self.optimizer if self.optimizer is not None else optax.adam(self.learning_rate)
         opt_state = tx.init(params)
 
         @jax.jit
         def step(params, opt_state, batch_inputs, batch_labels):
             loss, grads = jax.value_and_grad(loss_fn)(params, batch_inputs, batch_labels)
-            updates, opt_state = tx.update(grads, opt_state)
+            updates, opt_state = tx.update(grads, opt_state, params)
             return optax.apply_updates(params, updates), opt_state, loss
 
         n = len(labels)

@@ -1,0 +1,76 @@
+"""Text-branch training: fine-tune the BERT classifier on simulated text.
+
+The reference never trains its text model (the transformers serving path
+returns random numbers, model_manager.py:332-336). Here the generator's
+merchant pool provides supervision: transaction text assembled the same way
+serving assembles it, labeled with the stream's fraud labels. Suspicious
+merchant names (crypto/gift-card/wire tokens) correlate with high-risk
+categories and fraud, giving the encoder a learnable signal.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from realtime_fraud_detection_tpu.models.bert import (
+    BertConfig,
+    bert_logits,
+    init_bert_params,
+)
+from realtime_fraud_detection_tpu.models.text import combined_text
+from realtime_fraud_detection_tpu.models.tokenizer import FraudTokenizer
+
+
+def build_text_dataset(
+    generator, n_transactions: int, max_length: int = 64
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """(input_ids, attention_mask, labels) from a simulated stream."""
+    tok = FraudTokenizer(max_length=max_length)
+    texts, labels = [], []
+    _, lab = generator.generate_encoded(n_transactions)
+    mp = generator.merchants
+    for i in range(n_transactions):
+        m = int(lab["merchant_index"][i])
+        texts.append(combined_text({
+            "merchant_name": str(mp.names[m]),
+            "category": str(mp.category[m]),
+        }))
+        labels.append(float(lab["is_fraud"][i]))
+    ids, mask = tok.encode_batch(texts)
+    return ids, mask, np.asarray(labels, np.float32)
+
+
+def train_bert(
+    generator,
+    config: BertConfig | None = None,
+    n_transactions: int = 20_000,
+    max_length: int = 64,
+    batch_size: int = 64,
+    epochs: int = 2,
+    learning_rate: float = 5e-5,
+    seed: int = 0,
+) -> Dict:
+    """Fine-tune (from random init) the classifier on stream text."""
+    from realtime_fraud_detection_tpu.training.neural import NeuralTrainer
+
+    config = config or BertConfig()
+    ids, mask, labels = build_text_dataset(generator, n_transactions, max_length)
+    params = init_bert_params(jax.random.PRNGKey(seed), config)
+
+    def loss_fn(p, inputs, by):
+        bi, bm = inputs
+        logits = bert_logits(p, bi, bm, config)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, by.astype(jnp.int32)
+        ).mean()
+
+    trainer = NeuralTrainer(
+        batch_size=batch_size, epochs=epochs, seed=seed,
+        optimizer=optax.adamw(learning_rate),
+    )
+    return trainer.train(params, loss_fn, (ids, mask), labels)

@@ -150,3 +150,10 @@ class TestEndToEndTraining:
         p = np.asarray(gnn_predict(params, *[np.asarray(a) for a in inputs]))
         auc = _auc(labels, p)
         assert auc > 0.7, f"AUC {auc:.3f}"
+
+
+class TestNodeDimGuard:
+    def test_small_node_dim_rejected(self):
+        gen = TransactionGenerator(num_users=10, num_merchants=5, seed=0)
+        with pytest.raises(ValueError, match="node_dim"):
+            build_node_features(gen.users, gen.merchants, node_dim=8)

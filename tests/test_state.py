@@ -132,3 +132,36 @@ class TestProfileStore:
         assert p.get_user("u1")["risk_score"] == 0.2
         assert p.get_merchant("m1")["category"] == "retail"
         assert p.get_user("nope") is None
+
+
+class TestReviewRegressions:
+    def test_velocity_default_read_uses_stream_clock(self):
+        v = VelocityStore()
+        v.update("u1", 100.0, now=0.0)
+        v.update("u2", 1.0, now=7200.0)  # advances the stream clock
+        # u1's 5min/1hour windows are stale relative to stream time
+        assert v.get("u1", "5min") == {}
+        assert v.get("u1", "1hour") == {}
+        assert v.get("u1", "24hour")["count"] == 1
+
+    def test_aggregation_uses_iso_event_time(self):
+        from datetime import datetime, timezone
+
+        a = AggregationStore()
+        ts = datetime(2026, 1, 5, 10, 30, tzinfo=timezone.utc)
+        a.record({"timestamp": ts.isoformat(), "amount": 10.0,
+                  "merchant_id": "m"}, now=0)
+        hour_key = int(ts.timestamp() * 1000 // 3_600_000)
+        assert a.get(f"hourly:{hour_key}", now=0)["total_count"] == 1
+
+    def test_two_hop_neighbors(self):
+        g = EntityGraphStore(fanout=2)
+        g.add_edges([1, 2], [10, 10])   # users 1,2 -> merchant 10
+        g.add_edges([1], [11])          # user 1 -> merchant 11
+        hop1, m1, hop2, m2 = g.user_two_hop([1])
+        assert set(hop1[0][m1[0]]) == {10, 11}
+        # 2-hop: users reachable through merchant 10 include user 2
+        flat = hop2[0][m2[0]]
+        assert 2 in flat
+        # masked slots carry no fabricated neighbors
+        assert m2.shape == (1, 2, 2)
