@@ -48,6 +48,18 @@ def combined_text(text_data: Mapping[str, str]) -> str:
     return " | ".join(parts)
 
 
+def _keyword_hit(text: str, keywords) -> bool:
+    # word-boundary match for single short keywords ("irs" must not fire
+    # inside "first"); plain substring for multi-word phrases
+    for k in keywords:
+        if " " in k or len(k) >= 6:
+            if k in text:
+                return True
+        elif re.search(rf"\b{re.escape(k)}\b", text):
+            return True
+    return False
+
+
 def detect_fraud_patterns(text_data: Mapping[str, str]) -> Dict[str, bool]:
     """Rule-based keyword detection (bert_text_analyzer.py:283-344)."""
     all_text = " ".join(
@@ -55,11 +67,11 @@ def detect_fraud_patterns(text_data: Mapping[str, str]) -> Dict[str, bool]:
         for k in ("merchant_name", "description", "category", "location")
     ).lower()
     return {
-        "crypto_keywords": any(k in all_text for k in CRYPTO_KEYWORDS),
-        "gift_card_keywords": any(k in all_text for k in GIFT_CARD_KEYWORDS),
-        "urgent_language": any(k in all_text for k in URGENT_KEYWORDS),
-        "suspicious_merchant": any(k in all_text for k in SUSPICIOUS_PATTERNS),
-        "known_scam_patterns": any(k in all_text for k in SCAM_PATTERNS),
+        "crypto_keywords": _keyword_hit(all_text, CRYPTO_KEYWORDS),
+        "gift_card_keywords": _keyword_hit(all_text, GIFT_CARD_KEYWORDS),
+        "urgent_language": _keyword_hit(all_text, URGENT_KEYWORDS),
+        "suspicious_merchant": _keyword_hit(all_text, SUSPICIOUS_PATTERNS),
+        "known_scam_patterns": _keyword_hit(all_text, SCAM_PATTERNS),
     }
 
 
@@ -113,13 +125,24 @@ class TextAnalyzer:
         self.use_pallas = use_pallas
         self.total_predictions = 0
         self.total_time_ms = 0.0
+        self._predict = jax.jit(
+            lambda p, ids, mask: bert_predict(
+                p, ids, mask, self.config, self.use_pallas
+            )
+        )
 
     def score_texts(self, texts: Sequence[str]) -> np.ndarray:
-        """Fraud probability per text, one encoder call. f32[N]."""
-        ids, mask = self.tokenizer.encode_batch(texts)
-        return np.asarray(
-            bert_predict(self.params, ids, mask, self.config, self.use_pallas)
+        """Fraud probability per text, one compiled encoder call. f32[N].
+
+        Batch is padded to a power-of-two bucket so ragged per-transaction
+        field counts don't trigger a recompile per distinct size.
+        """
+        n = len(texts)
+        bucket = 1 << max(0, (n - 1).bit_length())
+        ids, mask = self.tokenizer.encode_batch(
+            list(texts) + [""] * (bucket - n)
         )
+        return np.asarray(self._predict(self.params, ids, mask))[:n]
 
     def analyze_transaction_text(
         self, batch: Sequence[Mapping[str, str]]

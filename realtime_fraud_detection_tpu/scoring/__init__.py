@@ -1,0 +1,13 @@
+"""Fused ensemble scoring: device pipeline + host orchestrator."""
+
+from realtime_fraud_detection_tpu.scoring.pipeline import (  # noqa: F401
+    MODEL_NAMES,
+    NUM_MODELS,
+    ScoreBatch,
+    ScorerConfig,
+    ScoringModels,
+    init_scoring_models,
+    make_example_batch,
+    score_fused,
+)
+from realtime_fraud_detection_tpu.scoring.scorer import FraudScorer  # noqa: F401

@@ -1,0 +1,337 @@
+"""Host-side scoring orchestrator around the fused device program.
+
+Plays the combined role of the reference's Flink ``TransactionProcessor``
+(profile/velocity joins, TransactionProcessor.java:51-92), the serving
+``FeatureProcessor`` + ``EnsemblePredictor`` (main.py:146-215), and the
+``RedisTransactionSink`` state write-backs (RedisTransactionSink.java:53-135)
+— but restructured TPU-first:
+
+  host: join state -> encode dense batch -> pad to bucket -> shard over mesh
+  device: ONE fused XLA program (features + 5 branches + ensemble + decisions)
+  host: unpad -> response dicts -> state write-back
+
+State reads happen before scoring and writes after, matching the reference's
+read-then-sink ordering, but single-writer per process (fixing the
+RMW races noted in SURVEY.md §5.2).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Mapping, Optional, Sequence
+
+import jax
+import numpy as np
+
+from realtime_fraud_detection_tpu.core.batching import (
+    BATCH_BUCKETS,
+    pad_to_bucket,
+)
+from realtime_fraud_detection_tpu.core.mesh import (
+    build_mesh,
+    local_mesh_size,
+    shard_batch,
+)
+from realtime_fraud_detection_tpu.ensemble.combine import EnsembleParams
+from realtime_fraud_detection_tpu.features.rules import (
+    DECISIONS,
+    RISK_LEVEL_NAMES,
+)
+from realtime_fraud_detection_tpu.features.schema import encode_transactions
+from realtime_fraud_detection_tpu.models.bert import BertConfig, TINY_CONFIG
+from realtime_fraud_detection_tpu.models.text import combined_text
+from realtime_fraud_detection_tpu.models.tokenizer import FraudTokenizer
+from realtime_fraud_detection_tpu.scoring.pipeline import (
+    MODEL_NAMES,
+    NUM_MODELS,
+    ScoreBatch,
+    ScorerConfig,
+    ScoringModels,
+    init_scoring_models,
+    score_fused,
+)
+from realtime_fraud_detection_tpu.state.history import (
+    EntityGraphStore,
+    UserHistoryStore,
+)
+from realtime_fraud_detection_tpu.state.stores import (
+    ProfileStore,
+    TransactionCache,
+    VelocityStore,
+)
+from realtime_fraud_detection_tpu.utils.config import Config
+
+
+class _EntityIndex:
+    """Stable string-id -> dense int index with on-the-fly node features."""
+
+    def __init__(self, node_dim: int):
+        self.node_dim = node_dim
+        self._idx: Dict[str, int] = {}
+        self._rows: List[np.ndarray] = []
+        self._profiled: set[str] = set()
+
+    def lookup(self, entity_id: str, profile: Optional[Mapping[str, Any]],
+               is_merchant: bool) -> int:
+        i = self._idx.get(entity_id)
+        if i is None:
+            i = len(self._rows)
+            self._idx[entity_id] = i
+            self._rows.append(self._featurize(profile, is_merchant))
+        elif profile is not None and entity_id not in self._profiled:
+            # a profile arrived after first sight — refresh the stale zero row
+            self._rows[i] = self._featurize(profile, is_merchant)
+        if profile is not None:
+            self._profiled.add(entity_id)
+        return i
+
+    def _featurize(self, p: Optional[Mapping[str, Any]], is_merchant: bool) -> np.ndarray:
+        """Node features mirroring models.gnn.build_node_features slots."""
+        row = np.zeros((self.node_dim,), np.float32)
+        if p is None:
+            row[8] = 1.0 if is_merchant else 0.0
+            return row
+        if is_merchant:
+            from realtime_fraud_detection_tpu.features.schema import (
+                MERCHANT_CATEGORIES,
+                _code,
+            )
+
+            risk = {"low": 0, "medium": 1, "high": 2}.get(str(p.get("risk_level")), 1)
+            hours = p.get("operating_hours") or {}
+            row[0] = risk / 2.0
+            row[1] = float(p.get("fraud_rate", 0.05))
+            row[2] = np.log1p(float(p.get("avg_transaction_amount", 0.0)))
+            row[3] = float(bool(p.get("is_blacklisted", False)))
+            row[4] = _code(MERCHANT_CATEGORIES, p.get("category")) / 10.0
+            row[5] = float(hours.get("start_hour", 0)) / 24.0
+            row[6] = float(hours.get("end_hour", 24)) / 24.0
+            row[8] = 1.0
+        else:
+            patterns = p.get("behavioral_patterns") or {}
+            row[0] = float(p.get("risk_score", 0.5))
+            row[1] = np.log1p(float(p.get("avg_transaction_amount", 0.0)))
+            row[2] = float(p.get("transaction_frequency", 0.0))
+            row[3] = float(p.get("account_age_days", 0.0)) / 365.0
+            row[4] = float(str(p.get("kyc_status", "")) == "verified")
+            row[5] = float(patterns.get("weekend_activity", 0.5))
+            row[6] = float(patterns.get("international_transactions", 0.0) or 0.0)
+            row[7] = float(patterns.get("online_preference", 0.7))
+        return row
+
+    def table(self) -> np.ndarray:
+        if not self._rows:
+            return np.zeros((1, self.node_dim), np.float32)
+        return np.stack(self._rows, axis=0)
+
+
+class FraudScorer:
+    """Stateful streaming scorer: the framework's flagship serving object."""
+
+    def __init__(
+        self,
+        config: Optional[Config] = None,
+        models: Optional[ScoringModels] = None,
+        mesh=None,
+        scorer_config: Optional[ScorerConfig] = None,
+        bert_config: BertConfig = TINY_CONFIG,
+        seed: int = 0,
+    ):
+        self.config = config or Config()
+        self.sc = scorer_config or ScorerConfig()
+        self.bert_config = bert_config
+        self.mesh = mesh if mesh is not None else build_mesh()
+        self.models = models if models is not None else init_scoring_models(
+            jax.random.PRNGKey(seed), bert_config=bert_config,
+            feature_dim=self.sc.feature_dim, node_dim=self.sc.node_dim,
+            seq_len=self.sc.seq_len,
+        )
+        self.ensemble_params = EnsembleParams.from_config(self.config, MODEL_NAMES)
+        enabled = self.config.get_enabled_models()
+        self.model_valid = np.asarray(
+            [n in enabled for n in MODEL_NAMES], bool
+        )
+
+        # streaming state (the Redis-equivalent plane, SURVEY.md §2.5)
+        self.profiles = ProfileStore()
+        self.velocity = VelocityStore()
+        self.history = UserHistoryStore(self.sc.seq_len, self.sc.feature_dim)
+        self.graph = EntityGraphStore(self.sc.fanout)
+        self.txn_cache = TransactionCache()
+        self.tokenizer = FraudTokenizer(
+            vocab_size=bert_config.vocab_size, max_length=self.sc.text_len
+        )
+        self._users = _EntityIndex(self.sc.node_dim)
+        self._merchants = _EntityIndex(self.sc.node_dim)
+        self.stats: Dict[str, float] = {"scored": 0, "batches": 0, "total_time_s": 0.0}
+
+    # ------------------------------------------------------------- state plane
+    def seed_profiles(self, users: Mapping[str, Mapping[str, Any]],
+                      merchants: Mapping[str, Mapping[str, Any]]) -> None:
+        self.profiles.seed(users, merchants)
+
+    # ---------------------------------------------------------------- assembly
+    def assemble(self, records: Sequence[Mapping[str, Any]],
+                 now: Optional[float] = None) -> ScoreBatch:
+        """Join state + encode one dense ScoreBatch (host side of the seam)."""
+        user_ids = [str(r.get("user_id", "")) for r in records]
+        merchant_ids = [str(r.get("merchant_id", "")) for r in records]
+        uprofs = {u: p for u in user_ids
+                  if (p := self.profiles.get_user(u)) is not None}
+        mprofs = {m: p for m in merchant_ids
+                  if (p := self.profiles.get_merchant(m)) is not None}
+        velocities = {u: self.velocity.get_all(u, now) for u in set(user_ids)}
+
+        txn = encode_transactions(records, uprofs, mprofs, velocities)
+
+        # feature history for the LSTM branch: append-then-gather semantics
+        from realtime_fraud_detection_tpu.features.extract import extract_features
+        feats = np.asarray(extract_features(txn))
+        history, history_len = self.history.append_and_gather(user_ids, feats)
+
+        # entity graph for the GNN branch
+        u_idx = [self._users.lookup(u, uprofs.get(u), False) for u in user_ids]
+        m_idx = [self._merchants.lookup(m, mprofs.get(m), True) for m in merchant_ids]
+        un_idx, un_mask = self.graph.user_neighbors(u_idx)
+        mn_idx, mn_mask = self.graph.merchant_neighbors(m_idx)
+        utable, mtable = self._users.table(), self._merchants.table()
+        user_feat = utable[u_idx]
+        merchant_feat = mtable[m_idx]
+        un_feat = mtable[np.where(un_mask, un_idx, 0)]
+        mn_feat = utable[np.where(mn_mask, mn_idx, 0)]
+        self.graph.add_edges(u_idx, m_idx)
+
+        # text branch tokens
+        texts = []
+        for r, m in zip(records, merchant_ids):
+            mp = mprofs.get(m) or {}
+            texts.append(combined_text({
+                "merchant_name": mp.get("name") or str(r.get("merchant_name", "")),
+                "description": str(r.get("description", "") or ""),
+                "category": str(mp.get("category", "") or ""),
+                "location": str(r.get("location", "") or ""),
+            }))
+        token_ids, token_mask = self.tokenizer.encode_batch(texts)
+
+        return ScoreBatch(
+            txn=txn,
+            history=history,
+            history_len=history_len,
+            user_feat=user_feat,
+            merchant_feat=merchant_feat,
+            user_neigh_feat=un_feat,
+            user_neigh_mask=un_mask,
+            merch_neigh_feat=mn_feat,
+            merch_neigh_mask=mn_mask,
+            token_ids=token_ids.astype(np.int32),
+            token_mask=token_mask.astype(bool),
+            valid=np.ones((len(records),), bool),
+        )
+
+    # ----------------------------------------------------------------- scoring
+    def score_batch(self, records: Sequence[Mapping[str, Any]],
+                    now: Optional[float] = None) -> List[Dict[str, Any]]:
+        """Score transaction dicts -> FraudPrediction dicts (§2.7 schema)."""
+        t0 = time.perf_counter()
+        n = len(records)
+        if n == 0:
+            return []
+        batch = self.assemble(records, now)
+        padded, _, _ = pad_to_bucket(
+            batch, n, BATCH_BUCKETS, multiple_of=local_mesh_size(self.mesh)
+        )
+        # fix the validity mask after padding (pad rows replicate row 0's True)
+        size = padded.history.shape[0]
+        valid = np.zeros((size,), bool)
+        valid[:n] = True
+        padded = padded.replace(valid=valid)
+        sharded = shard_batch(self.mesh, padded)
+
+        out = score_fused(
+            self.models, sharded, self.ensemble_params,
+            jax.device_put(self.model_valid),
+            bert_config=self.bert_config, use_pallas=self.sc.use_pallas,
+        )
+        out = jax.device_get(out)
+
+        elapsed_ms = (time.perf_counter() - t0) * 1000.0
+        results = self._build_responses(records, out, n, elapsed_ms)
+        self._write_back(records, results, now)
+        self.stats["scored"] += n
+        self.stats["batches"] += 1
+        self.stats["total_time_s"] += elapsed_ms / 1000.0
+        return results
+
+    def _build_responses(self, records, out, n, elapsed_ms) -> List[Dict[str, Any]]:
+        probs = np.asarray(out["fraud_probability"])[:n]
+        conf = np.asarray(out["confidence"])[:n]
+        decisions = np.asarray(out["decision"])[:n]
+        risk = np.asarray(out["risk_level"])[:n]
+        preds = np.asarray(out["model_predictions"])[:n]
+        rule = np.asarray(out["rule_score"])[:n]
+        high_amount = np.asarray(out["high_amount"])[:n]
+        unusual_hour = np.asarray(out["unusual_hour"])[:n]
+        high_risk_payment = np.asarray(out["high_risk_payment"])[:n]
+        per_txn_ms = elapsed_ms / max(n, 1)
+
+        results = []
+        weights = np.asarray(self.ensemble_params.weights)
+        for i, rec in enumerate(records):
+            model_predictions = {
+                name: float(preds[i, j])
+                for j, name in enumerate(MODEL_NAMES) if self.model_valid[j]
+            }
+            factors = []
+            if high_amount[i]:
+                factors.append("high_transaction_amount")
+            if unusual_hour[i]:
+                factors.append("unusual_transaction_hour")
+            if high_risk_payment[i]:
+                factors.append("high_risk_payment_method")
+            contributions = {
+                name: float(weights[j] * preds[i, j])
+                for j, name in enumerate(MODEL_NAMES) if self.model_valid[j]
+            }
+            results.append({
+                "transaction_id": str(rec.get("transaction_id", "")),
+                "fraud_probability": float(probs[i]),
+                "fraud_score": float(probs[i]),
+                "risk_level": RISK_LEVEL_NAMES[int(risk[i])],
+                "decision": DECISIONS[int(decisions[i])],
+                "model_predictions": model_predictions,
+                "confidence": float(conf[i]),
+                "processing_time_ms": per_txn_ms,
+                "explanation": {
+                    "model_contributions": contributions,
+                    "key_factors": factors,
+                    "rule_score": float(rule[i]),
+                },
+            })
+        return results
+
+    def _write_back(self, records, results, now: Optional[float]) -> None:
+        """Post-scoring state updates (RedisTransactionSink.java:53-135)."""
+        ts = now if now is not None else time.time()
+        for rec, res in zip(records, results):
+            uid = str(rec.get("user_id", ""))
+            self.velocity.update(uid, float(rec.get("amount", 0.0)), ts)
+            merged = dict(rec)
+            merged["fraud_score"] = res["fraud_score"]
+            merged["decision"] = res["decision"]
+            self.txn_cache.cache_transaction(merged, now=ts)
+
+    # ------------------------------------------------------------------ info
+    def model_info(self) -> Dict[str, Any]:
+        norm = self.config.normalized_weights()
+        return {
+            "models": {
+                name: {
+                    "enabled": bool(self.model_valid[j]),
+                    "weight": float(norm.get(name, 0.0)),
+                }
+                for j, name in enumerate(MODEL_NAMES)
+            },
+            "strategy": self.config.ensemble.strategy,
+            "num_models": NUM_MODELS,
+            "mesh": dict(self.mesh.shape),
+        }

@@ -1,0 +1,109 @@
+"""Tests for the fused scoring pipeline + host orchestrator."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from realtime_fraud_detection_tpu.ensemble.combine import EnsembleParams
+from realtime_fraud_detection_tpu.features.rules import DECISIONS, RISK_LEVEL_NAMES
+from realtime_fraud_detection_tpu.models.bert import TINY_CONFIG
+from realtime_fraud_detection_tpu.scoring import (
+    MODEL_NAMES,
+    FraudScorer,
+    ScorerConfig,
+    init_scoring_models,
+    make_example_batch,
+    score_fused,
+)
+from realtime_fraud_detection_tpu.sim.simulator import TransactionGenerator
+from realtime_fraud_detection_tpu.utils.config import Config
+
+
+@pytest.fixture(scope="module")
+def models():
+    return init_scoring_models(jax.random.PRNGKey(0), bert_config=TINY_CONFIG)
+
+
+@pytest.fixture(scope="module")
+def ens_params():
+    return EnsembleParams.from_config(Config(), list(MODEL_NAMES))
+
+
+def test_score_fused_shapes(models, ens_params):
+    b = 8
+    batch = make_example_batch(b)
+    out = score_fused(
+        models, batch, ens_params, jnp.ones((len(MODEL_NAMES),), bool),
+        bert_config=TINY_CONFIG,
+    )
+    assert out["fraud_probability"].shape == (b,)
+    assert out["model_predictions"].shape == (b, len(MODEL_NAMES))
+    assert out["decision"].shape == (b,)
+    p = np.asarray(out["fraud_probability"])
+    assert np.all((p >= 0) & (p <= 1))
+    assert np.all(np.isfinite(np.asarray(out["features"])))
+
+
+def test_score_fused_model_failure_mask(models, ens_params):
+    """A disabled/failed branch is excluded and the rest renormalize
+    (ensemble_predictor.py:175-182)."""
+    batch = make_example_batch(4)
+    all_valid = score_fused(models, batch, ens_params,
+                            jnp.ones((5,), bool), bert_config=TINY_CONFIG)
+    no_bert = score_fused(models, batch, ens_params,
+                          jnp.asarray([True, True, False, True, True]),
+                          bert_config=TINY_CONFIG)
+    preds = np.asarray(all_valid["model_predictions"])
+    w = np.asarray(ens_params.weights)
+    mask = np.asarray([1.0, 1.0, 0.0, 1.0, 1.0])
+    expect = (preds * w * mask).sum(1) / (w * mask).sum()
+    np.testing.assert_allclose(
+        np.asarray(no_bert["fraud_probability"]), expect, rtol=1e-5
+    )
+
+
+def test_fraud_scorer_end_to_end():
+    gen = TransactionGenerator(num_users=50, num_merchants=20, seed=1)
+    scorer = FraudScorer(scorer_config=ScorerConfig(text_len=32))
+    scorer.seed_profiles(gen.users.profiles(), gen.merchants.profiles())
+    records = gen.generate_batch(12)
+    results = scorer.score_batch(records, now=1000.0)
+    assert len(results) == 12
+    for r in results:
+        assert 0.0 <= r["fraud_probability"] <= 1.0
+        assert r["decision"] in DECISIONS
+        assert r["risk_level"] in RISK_LEVEL_NAMES
+        assert set(r["model_predictions"]) == set(MODEL_NAMES)
+        assert "model_contributions" in r["explanation"]
+
+
+def test_fraud_scorer_state_accumulates():
+    """Velocity and history state must accumulate across calls."""
+    gen = TransactionGenerator(num_users=3, num_merchants=3, seed=2)
+    scorer = FraudScorer(scorer_config=ScorerConfig(text_len=32))
+    scorer.seed_profiles(gen.users.profiles(), gen.merchants.profiles())
+    recs = gen.generate_batch(6)
+    scorer.score_batch(recs, now=1000.0)
+    uid = str(recs[0]["user_id"])
+    vel = scorer.velocity.get_all(uid, now=1001.0)
+    assert vel["5min"]["count"] >= 1
+    assert len(scorer.history) >= 1
+    scorer.score_batch(gen.generate_batch(4), now=1010.0)
+    assert scorer.stats["scored"] == 10
+
+
+def test_fraud_scorer_padding_invariance():
+    """Bucket padding must not change real-row scores."""
+    gen = TransactionGenerator(num_users=20, num_merchants=10, seed=3)
+    recs = gen.generate_batch(8)
+
+    def run(batch_records):
+        s = FraudScorer(scorer_config=ScorerConfig(text_len=32), seed=0)
+        s.seed_profiles(gen.users.profiles(), gen.merchants.profiles())
+        return s.score_batch(batch_records, now=1000.0)
+
+    r5 = run(recs[:5])   # pads 5 -> bucket 8
+    r8 = run(recs[:8])   # exact bucket
+    for a, b in zip(r5, r8[:5]):
+        assert a["fraud_probability"] == pytest.approx(b["fraud_probability"], rel=1e-5)
