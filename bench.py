@@ -1,0 +1,89 @@
+"""Benchmark: fused 5-branch ensemble scoring on one TPU chip.
+
+Prints ONE JSON line: the headline metric is full-ensemble scoring throughput
+(transactions/sec/chip) at microbatch 256, with p50/p99 scoring latency at
+batch 1/32/256 attached (BASELINE.json driver metric). ``vs_baseline``
+compares against the reference's claimed 15,000 TPS sustained for its entire
+multi-node cluster (reference README.md:201) — our number is one chip.
+
+Timing discipline (axon tunnel): everything is measured with
+``block_until_ready`` BEFORE any device->host result pull — the first
+transfer drops the tunnel into synchronous mode and would poison later
+configs.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main() -> None:
+    from realtime_fraud_detection_tpu.ensemble.combine import EnsembleParams
+    from realtime_fraud_detection_tpu.models.bert import BertConfig
+    from realtime_fraud_detection_tpu.scoring import (
+        MODEL_NAMES,
+        ScorerConfig,
+        init_scoring_models,
+        make_example_batch,
+        score_fused,
+    )
+    from realtime_fraud_detection_tpu.utils.config import Config
+
+    on_tpu = jax.devices()[0].platform != "cpu"
+    # Real DistilBERT-base dimensions for the text branch (config.py:165-170),
+    # trimmed to 4 layers on CPU so local runs stay tractable.
+    bert_config = BertConfig() if on_tpu else BertConfig(num_layers=2)
+    sc = ScorerConfig(text_len=64, use_pallas=False)
+
+    models = init_scoring_models(
+        jax.random.PRNGKey(0), bert_config=bert_config,
+        feature_dim=sc.feature_dim, node_dim=sc.node_dim,
+    )
+    params = EnsembleParams.from_config(Config(), list(MODEL_NAMES))
+    model_valid = jnp.ones((len(MODEL_NAMES),), bool)
+
+    fn = jax.jit(
+        lambda m, b, p, v: score_fused(
+            m, b, p, v, bert_config=bert_config, use_pallas=sc.use_pallas,
+            with_model_preds=False,
+        )
+    )
+
+    lat: dict[int, dict[str, float]] = {}
+    throughput = 0.0
+    for bsz, iters in ((1, 200), (32, 100), (256, 50)):
+        batch = make_example_batch(bsz, sc, rng=np.random.default_rng(bsz))
+        out = fn(models, batch, params, model_valid)   # compile
+        jax.block_until_ready(out)
+        times = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            out = fn(models, batch, params, model_valid)
+            jax.block_until_ready(out)
+            times.append(time.perf_counter() - t0)
+        times_ms = np.asarray(times) * 1e3
+        lat[bsz] = {
+            "p50_ms": float(np.percentile(times_ms, 50)),
+            "p99_ms": float(np.percentile(times_ms, 99)),
+        }
+        if bsz == 256:
+            throughput = bsz * len(times) / float(np.sum(times))
+
+    baseline_tps = 15_000.0  # reference README.md:201 (whole cluster)
+    print(json.dumps({
+        "metric": "full-ensemble scoring throughput (5 branches, batch=256)",
+        "value": round(throughput, 1),
+        "unit": "txn/s/chip",
+        "vs_baseline": round(throughput / baseline_tps, 3),
+        "latency": {str(k): v for k, v in lat.items()},
+        "device": str(jax.devices()[0]),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -134,11 +134,14 @@ class TextAnalyzer:
     def score_texts(self, texts: Sequence[str]) -> np.ndarray:
         """Fraud probability per text, one compiled encoder call. f32[N].
 
-        Batch is padded to a power-of-two bucket so ragged per-transaction
-        field counts don't trigger a recompile per distinct size.
+        Batch is padded to the shared bucket set (core/batching.BATCH_BUCKETS)
+        so ragged per-transaction field counts don't trigger a recompile per
+        distinct size.
         """
+        from realtime_fraud_detection_tpu.core.batching import bucket_for
+
         n = len(texts)
-        bucket = 1 << max(0, (n - 1).bit_length())
+        bucket = bucket_for(n)
         ids, mask = self.tokenizer.encode_batch(
             list(texts) + [""] * (bucket - n)
         )

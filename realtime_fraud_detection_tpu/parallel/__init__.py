@@ -1,0 +1,22 @@
+"""Parallelism layer: sharding layouts, collectives, distributed training.
+
+The ICI/DCN equivalent of the reference's Kafka + Flink-shuffle + Redis
+communication fabric (SURVEY.md §2.8/§5.8), expressed as named-axis
+shardings that XLA lowers to collectives.
+"""
+
+from realtime_fraud_detection_tpu.parallel.layouts import (  # noqa: F401
+    batch_shardings,
+    bert_param_specs,
+    scoring_model_specs,
+    tree_specs_to_shardings,
+)
+from realtime_fraud_detection_tpu.parallel.train import (  # noqa: F401
+    TrainBatch,
+    TrainState,
+    init_train_state,
+    joint_loss,
+    make_train_step,
+    neural_param_shardings,
+    shard_train_batch,
+)

@@ -1,0 +1,101 @@
+"""Sharding layouts: how every parameter and batch tensor maps onto the mesh.
+
+The reference's only distribution strategy is data parallelism (Kafka
+partitions x Flink parallelism 12, SURVEY.md §2.8); its "communication
+backend" is Kafka + Flink's netty shuffle. The TPU-native equivalent is a
+named-axis layout table: annotate shardings here, and XLA's SPMD partitioner
+inserts the ICI collectives (the NCCL analog) automatically.
+
+Layout policy:
+- batch tensors: leading dim over ``data`` — pure DP, the Flink analog;
+- the DistilBERT encoder (the only branch with enough FLOPs to want it) gets
+  Megatron-style tensor parallelism over ``model``: q/k/v and ffn1 split on
+  the output feature dim, o and ffn2 on the input dim, so each attention+FFN
+  block needs exactly one all-reduce pair, riding ICI;
+- every other branch (GBDT, iforest, LSTM, GraphSAGE) is tiny: replicated
+  params, sharded batch.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from realtime_fraud_detection_tpu.core.mesh import DATA_AXIS, MODEL_AXIS
+
+
+def _named(mesh: Mesh, spec: P) -> NamedSharding:
+    return NamedSharding(mesh, spec)
+
+
+def replicated(mesh: Mesh):
+    return _named(mesh, P())
+
+
+def batch_spec() -> P:
+    return P(DATA_AXIS)
+
+
+def bert_layer_specs() -> Dict[str, Any]:
+    """Megatron TP specs for one encoder layer (column/row parallel pairs)."""
+    col = {"w": P(None, MODEL_AXIS), "b": P(MODEL_AXIS)}       # split heads/ffn
+    row = {"w": P(MODEL_AXIS, None), "b": P()}                 # partial-sum in
+    ln = {"scale": P(), "bias": P()}
+    return {
+        "q": col, "k": col, "v": col, "o": row,
+        "attn_ln": ln,
+        "ffn1": col, "ffn2": row,
+        "ffn_ln": ln,
+    }
+
+
+def bert_param_specs(params: Dict[str, Any]) -> Dict[str, Any]:
+    """PartitionSpec pytree matching models.bert.init_bert_params' structure."""
+    dense_rep = {"w": P(), "b": P()}
+    ln = {"scale": P(), "bias": P()}
+    return {
+        "word_emb": P(None, None),   # gathered by token ids; keep replicated
+        "pos_emb": P(None, None),
+        "emb_ln": ln,
+        "layers": [bert_layer_specs() for _ in params["layers"]],
+        "pre_classifier": dense_rep,
+        "classifier": dense_rep,
+    }
+
+
+def scoring_model_specs(models) -> Any:
+    """PartitionSpec pytree for a full ScoringModels set.
+
+    Trees/iforest/LSTM/GNN are replicated (far below the ~1 MB/chip where TP
+    would pay); the BERT branch is TP over ``model``.
+    """
+    rep = lambda tree: jax.tree_util.tree_map(lambda _: P(), tree)  # noqa: E731
+    return type(models)(
+        trees=rep(models.trees),
+        iforest=rep(models.iforest),
+        lstm=rep(models.lstm),
+        gnn=rep(models.gnn),
+        bert=bert_param_specs(models.bert),
+    )
+
+
+def tree_specs_to_shardings(mesh: Mesh, specs: Any) -> Any:
+    return jax.tree_util.tree_map(
+        lambda s: _named(mesh, s),
+        specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def batch_shardings(mesh: Mesh, tree: Any) -> Any:
+    """NamedShardings sharding every leaf's leading dim over ``data``."""
+
+    def _spec(x):
+        nd = getattr(x, "ndim", 0)
+        if nd == 0:
+            return _named(mesh, P())
+        return _named(mesh, P(DATA_AXIS, *([None] * (nd - 1))))
+
+    return jax.tree_util.tree_map(_spec, tree)

@@ -1,0 +1,146 @@
+"""Distributed joint training step for the neural branches.
+
+The reference's trainer (model_trainer.py:41-121) trains XGBoost/iforest
+offline on a single CPU and never trains the LSTM/BERT/GNN at all
+(model_trainer.py docstring claim vs SURVEY.md §3.5). Here training is a
+first-class distributed program: one jitted step computes the joint loss of
+all three neural branches and updates them with optax, with
+
+- **DP** over the ``data`` mesh axis (gradient all-reduce inserted by XLA
+  because params are replicated over ``data``), and
+- **TP** for the DistilBERT branch over ``model`` (parallel.layouts specs).
+
+``init_train_state`` device_puts params according to the layout table before
+``optimizer.init``, so Adam moments inherit the exact same shardings and the
+whole state stays distributed across steps.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+from jax.sharding import Mesh
+
+from realtime_fraud_detection_tpu.models.bert import BertConfig, bert_logits
+from realtime_fraud_detection_tpu.models.gnn import gnn_logits
+from realtime_fraud_detection_tpu.models.lstm import lstm_logits
+from realtime_fraud_detection_tpu.parallel.layouts import (
+    batch_shardings,
+    bert_param_specs,
+    tree_specs_to_shardings,
+)
+from realtime_fraud_detection_tpu.training.neural import bce_loss
+
+
+@struct.dataclass
+class TrainBatch:
+    """Dense supervised batch for the three neural branches."""
+
+    features: jax.Array          # f32[B, 64] (the §2.3 contract)
+    history: jax.Array           # f32[B, T, F]
+    history_len: jax.Array       # i32[B]
+    user_feat: jax.Array         # f32[B, D]
+    merchant_feat: jax.Array     # f32[B, D]
+    user_neigh_feat: jax.Array   # f32[B, K, D]
+    user_neigh_mask: jax.Array   # bool[B, K]
+    merch_neigh_feat: jax.Array  # f32[B, K, D]
+    merch_neigh_mask: jax.Array  # bool[B, K]
+    token_ids: jax.Array         # i32[B, S]
+    token_mask: jax.Array        # bool[B, S]
+    labels: jax.Array            # f32[B] fraud ground truth
+
+
+@struct.dataclass
+class TrainState:
+    params: Dict[str, Any]       # {"lstm": ..., "gnn": ..., "bert": ...}
+    opt_state: Any
+    step: jax.Array
+
+
+def neural_param_shardings(mesh: Mesh, params: Dict[str, Any]) -> Dict[str, Any]:
+    """Layout table for the joint neural param dict (bert TP, rest replicated)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    rep = jax.tree_util.tree_map(lambda _: NamedSharding(mesh, P()), {
+        "lstm": params["lstm"], "gnn": params["gnn"],
+    })
+    bert = tree_specs_to_shardings(mesh, bert_param_specs(params["bert"]))
+    return {"lstm": rep["lstm"], "gnn": rep["gnn"], "bert": bert}
+
+
+def init_train_state(
+    mesh: Mesh,
+    params: Dict[str, Any],
+    optimizer: optax.GradientTransformation,
+) -> TrainState:
+    """Distribute params per the layout table, then init the optimizer on the
+    already-sharded params so moments land with identical shardings."""
+    sharded = jax.device_put(params, neural_param_shardings(mesh, params))
+    opt_state = optimizer.init(sharded)
+    return TrainState(params=sharded, opt_state=opt_state,
+                      step=jnp.zeros((), jnp.int32))
+
+
+def joint_loss(
+    params: Dict[str, Any],
+    batch: TrainBatch,
+    bert_config: BertConfig,
+    use_pallas: bool = False,
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Sum of per-branch BCE losses + per-branch aux dict."""
+    lstm_l = bce_loss(
+        lstm_logits(params["lstm"], batch.history, batch.history_len),
+        batch.labels,
+    )
+    gnn_l = bce_loss(
+        gnn_logits(
+            params["gnn"], batch.features, batch.user_feat,
+            batch.merchant_feat, batch.user_neigh_feat, batch.user_neigh_mask,
+            batch.merch_neigh_feat, batch.merch_neigh_mask,
+        ),
+        batch.labels,
+    )
+    logits2 = bert_logits(
+        params["bert"], batch.token_ids, batch.token_mask, bert_config,
+        use_pallas=use_pallas,
+    )
+    bert_l = bce_loss(logits2[:, 1] - logits2[:, 0], batch.labels)
+    total = lstm_l + gnn_l + bert_l
+    return total, {"lstm": lstm_l, "gnn": gnn_l, "bert": bert_l}
+
+
+def make_train_step(
+    optimizer: optax.GradientTransformation,
+    bert_config: BertConfig,
+    use_pallas: bool = False,
+    donate: bool = True,
+) -> Callable[[TrainState, TrainBatch], Tuple[TrainState, Dict[str, jax.Array]]]:
+    """Build the jitted joint train step.
+
+    Sharding is carried by the arrays themselves (init_train_state +
+    layouts.batch_shardings); jit propagates it and XLA inserts the DP
+    gradient all-reduce and the TP all-reduce pair per BERT block.
+    """
+
+    def step(state: TrainState, batch: TrainBatch):
+        (loss, aux), grads = jax.value_and_grad(joint_loss, has_aux=True)(
+            state.params, batch, bert_config, use_pallas
+        )
+        updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = TrainState(
+            params=new_params, opt_state=new_opt, step=state.step + 1
+        )
+        metrics = {"loss": loss, **aux}
+        return new_state, metrics
+
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+def shard_train_batch(mesh: Mesh, batch: TrainBatch) -> TrainBatch:
+    """Device-put a host batch with every leaf sharded over ``data``."""
+    return jax.device_put(batch, batch_shardings(mesh, batch))

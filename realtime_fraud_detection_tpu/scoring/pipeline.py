@@ -81,6 +81,7 @@ class ScoreBatch:
     """
 
     txn: TransactionBatch            # struct-of-arrays transaction batch
+    features: jax.Array              # f32[B, 64] extracted §2.3 features
     history: jax.Array               # f32[B, T, F] per-user txn history (front-padded)
     history_len: jax.Array           # i32[B] valid suffix lengths
     user_feat: jax.Array             # f32[B, D] center user node features
@@ -105,7 +106,6 @@ def init_scoring_models(
     node_dim: int = 16,
     n_trees: int = 100,
     tree_depth: int = 6,
-    seq_len: int = 10,
 ) -> ScoringModels:
     """Randomly-initialized model set (the reference's dummy-model fallback,
     model_manager.py:109-121, except ours are real architectures)."""
@@ -151,9 +151,11 @@ def score_fused(
     Returns fraud_probability/confidence/decision/risk_level f32|i32[B] plus
     per-model predictions (B, M), the rule-based score (B,) and key-factor
     flags — everything the §2.7 FraudPrediction response needs, computed in a
-    single fused XLA program.
+    single fused XLA program. Features are precomputed once by the assembler
+    (``ScoreBatch.features``) — they're also needed host-side for the
+    history store, so extracting here again would double the work.
     """
-    features = extract_features(batch.txn)                      # f32[B, 64]
+    features = batch.features                                   # f32[B, 64]
 
     preds = jnp.stack(
         [
@@ -184,7 +186,6 @@ def score_fused(
     out = dict(combined)
     out["rule_score"] = rule_score(batch.txn)
     out.update(_key_factors(batch.txn))
-    out["features"] = features
     if with_model_preds:
         out["model_predictions"] = preds
     return out
@@ -222,6 +223,7 @@ def make_example_batch(
     b, c = batch_size, config
     return ScoreBatch(
         txn=txn,
+        features=np.asarray(extract_features(txn)),
         history=rng.standard_normal((b, c.seq_len, c.feature_dim)).astype(np.float32),
         history_len=np.full((b,), c.seq_len, np.int32),
         user_feat=rng.standard_normal((b, c.node_dim)).astype(np.float32),

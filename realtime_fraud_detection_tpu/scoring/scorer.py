@@ -70,6 +70,7 @@ class _EntityIndex:
         self._idx: Dict[str, int] = {}
         self._rows: List[np.ndarray] = []
         self._profiled: set[str] = set()
+        self._table: Optional[np.ndarray] = None  # stacked-row cache
 
     def lookup(self, entity_id: str, profile: Optional[Mapping[str, Any]],
                is_merchant: bool) -> int:
@@ -78,9 +79,11 @@ class _EntityIndex:
             i = len(self._rows)
             self._idx[entity_id] = i
             self._rows.append(self._featurize(profile, is_merchant))
+            self._table = None
         elif profile is not None and entity_id not in self._profiled:
             # a profile arrived after first sight — refresh the stale zero row
             self._rows[i] = self._featurize(profile, is_merchant)
+            self._table = None
         if profile is not None:
             self._profiled.add(entity_id)
         return i
@@ -120,9 +123,11 @@ class _EntityIndex:
         return row
 
     def table(self) -> np.ndarray:
-        if not self._rows:
-            return np.zeros((1, self.node_dim), np.float32)
-        return np.stack(self._rows, axis=0)
+        if self._table is None:
+            if not self._rows:
+                return np.zeros((1, self.node_dim), np.float32)
+            self._table = np.stack(self._rows, axis=0)
+        return self._table
 
 
 class FraudScorer:
@@ -144,7 +149,6 @@ class FraudScorer:
         self.models = models if models is not None else init_scoring_models(
             jax.random.PRNGKey(seed), bert_config=bert_config,
             feature_dim=self.sc.feature_dim, node_dim=self.sc.node_dim,
-            seq_len=self.sc.seq_len,
         )
         self.ensemble_params = EnsembleParams.from_config(self.config, MODEL_NAMES)
         enabled = self.config.get_enabled_models()
@@ -215,6 +219,7 @@ class FraudScorer:
 
         return ScoreBatch(
             txn=txn,
+            features=feats,
             history=history,
             history_len=history_len,
             user_feat=user_feat,
@@ -237,14 +242,11 @@ class FraudScorer:
         if n == 0:
             return []
         batch = self.assemble(records, now)
-        padded, _, _ = pad_to_bucket(
+        padded, mask, _ = pad_to_bucket(
             batch, n, BATCH_BUCKETS, multiple_of=local_mesh_size(self.mesh)
         )
-        # fix the validity mask after padding (pad rows replicate row 0's True)
-        size = padded.history.shape[0]
-        valid = np.zeros((size,), bool)
-        valid[:n] = True
-        padded = padded.replace(valid=valid)
+        # pad rows replicate row 0's True flag; the real mask is the padder's
+        padded = padded.replace(valid=mask)
         sharded = shard_batch(self.mesh, padded)
 
         out = score_fused(

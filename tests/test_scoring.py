@@ -42,7 +42,6 @@ def test_score_fused_shapes(models, ens_params):
     assert out["decision"].shape == (b,)
     p = np.asarray(out["fraud_probability"])
     assert np.all((p >= 0) & (p <= 1))
-    assert np.all(np.isfinite(np.asarray(out["features"])))
 
 
 def test_score_fused_model_failure_mask(models, ens_params):
