@@ -1,0 +1,154 @@
+"""ctypes bindings for the native (C++) microbatcher.
+
+Builds ``microbatcher.cpp`` on demand with g++ (pybind11 is not in this
+image; the C ABI + ctypes keeps the dependency surface at zero). The build
+is cached next to the source keyed on its mtime; set
+``RTFD_DISABLE_NATIVE=1`` to force the pure-Python assembler.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import List, Optional
+
+_DIR = Path(__file__).resolve().parent
+_SRC = _DIR / "microbatcher.cpp"
+_LIB = _DIR / "_microbatcher.so"
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_error: Optional[str] = None
+
+
+def _build() -> Optional[ctypes.CDLL]:
+    global _build_error
+    if os.environ.get("RTFD_DISABLE_NATIVE") == "1":
+        _build_error = "disabled via RTFD_DISABLE_NATIVE"
+        return None
+    try:
+        if not _LIB.exists() or _LIB.stat().st_mtime < _SRC.stat().st_mtime:
+            cmd = [
+                "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+                str(_SRC), "-o", str(_LIB),
+            ]
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        lib = ctypes.CDLL(str(_LIB))
+    except (OSError, subprocess.SubprocessError) as e:
+        _build_error = str(e)
+        return None
+
+    lib.mb_create.restype = ctypes.c_void_p
+    lib.mb_create.argtypes = [ctypes.c_size_t, ctypes.c_size_t,
+                              ctypes.c_size_t, ctypes.c_double]
+    lib.mb_destroy.argtypes = [ctypes.c_void_p]
+    lib.mb_push.restype = ctypes.c_int
+    lib.mb_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32]
+    lib.mb_pending.restype = ctypes.c_size_t
+    lib.mb_pending.argtypes = [ctypes.c_void_p]
+    lib.mb_next_batch.restype = ctypes.c_int
+    lib.mb_next_batch.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
+        ctypes.POINTER(ctypes.c_uint32), ctypes.c_int,
+    ]
+    for name in ("mb_stat_batches", "mb_stat_records", "mb_stat_dropped"):
+        fn = getattr(lib, name)
+        fn.restype = ctypes.c_uint64
+        fn.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+def native_available() -> bool:
+    return _get_lib() is not None
+
+
+def native_build_error() -> Optional[str]:
+    _get_lib()
+    return _build_error
+
+
+def _get_lib() -> Optional[ctypes.CDLL]:
+    global _lib
+    with _lock:
+        if _lib is None and _build_error is None:
+            _lib = _build()
+        return _lib
+
+
+class NativeMicrobatchQueue:
+    """Lock-free MPMC ingest queue + deadline microbatcher (C++ backed).
+
+    Same close-condition contract as stream.microbatch.MicrobatchAssembler:
+    a batch closes when it reaches ``max_batch`` or when ``max_delay_ms`` has
+    passed since its oldest record was enqueued.
+    """
+
+    def __init__(self, capacity: int = 4096, slot_bytes: int = 4096,
+                 max_batch: int = 256, max_delay_ms: float = 5.0):
+        lib = _get_lib()
+        if lib is None:
+            raise RuntimeError(f"native microbatcher unavailable: {_build_error}")
+        self._lib = lib
+        self.slot_bytes = slot_bytes
+        self.max_batch = max_batch
+        self._q = ctypes.c_void_p(lib.mb_create(
+            capacity, slot_bytes, max_batch, max_delay_ms
+        ))
+        self._out_buf = ctypes.create_string_buffer(slot_bytes * max_batch)
+        self._out_lens = (ctypes.c_uint32 * max_batch)()
+
+    def _handle(self) -> ctypes.c_void_p:
+        if not self._q:
+            raise ValueError("queue is closed")
+        return self._q
+
+    def push(self, payload: bytes) -> bool:
+        """Enqueue one record; False when the ring is full (backpressure)."""
+        rc = self._lib.mb_push(self._handle(), payload, len(payload))
+        if rc == -2:
+            raise ValueError(
+                f"payload of {len(payload)} bytes exceeds slot size {self.slot_bytes}"
+            )
+        return rc == 0
+
+    def next_batch(self, block_ms: int = 0) -> List[bytes]:
+        n = self._lib.mb_next_batch(
+            self._handle(), self._out_buf, len(self._out_buf), self._out_lens,
+            block_ms,
+        )
+        if n <= 0:
+            return []
+        used = sum(self._out_lens[i] for i in range(n))
+        raw = ctypes.string_at(self._out_buf, used)  # copy used prefix only
+        out: List[bytes] = []
+        off = 0
+        for i in range(n):
+            ln = self._out_lens[i]
+            out.append(raw[off:off + ln])
+            off += ln
+        return out
+
+    def pending(self) -> int:
+        return int(self._lib.mb_pending(self._handle()))
+
+    def stats(self) -> dict:
+        h = self._handle()
+        return {
+            "batches": int(self._lib.mb_stat_batches(h)),
+            "records": int(self._lib.mb_stat_records(h)),
+            "dropped": int(self._lib.mb_stat_dropped(h)),
+        }
+
+    def close(self) -> None:
+        if self._q:
+            self._lib.mb_destroy(self._q)
+            self._q = ctypes.c_void_p(None)
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -167,6 +167,7 @@ class FraudScorer:
         )
         self._users = _EntityIndex(self.sc.node_dim)
         self._merchants = _EntityIndex(self.sc.node_dim)
+        self.last_features = np.zeros((0, self.sc.feature_dim), np.float32)
         self.stats: Dict[str, float] = {"scored": 0, "batches": 0, "total_time_s": 0.0}
 
     # ------------------------------------------------------------- state plane
@@ -191,6 +192,7 @@ class FraudScorer:
         # feature history for the LSTM branch: append-then-gather semantics
         from realtime_fraud_detection_tpu.features.extract import extract_features
         feats = np.asarray(extract_features(txn))
+        self.last_features = feats  # host copy for feature-topic fan-out
         history, history_len = self.history.append_and_gather(user_ids, feats)
 
         # entity graph for the GNN branch

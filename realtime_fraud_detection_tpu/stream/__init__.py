@@ -1,0 +1,23 @@
+"""Streaming layer: transport, microbatch assembly, and the scoring job."""
+
+from realtime_fraud_detection_tpu.stream.topics import (  # noqa: F401
+    ALERTS,
+    DECISIONS,
+    ENRICHED,
+    FEATURES,
+    PREDICTIONS,
+    TOPIC_SPECS,
+    TRANSACTIONS,
+)
+from realtime_fraud_detection_tpu.stream.transport import (  # noqa: F401
+    Consumer,
+    FaultInjector,
+    InMemoryBroker,
+    KafkaTransport,
+    Record,
+)
+from realtime_fraud_detection_tpu.stream.microbatch import (  # noqa: F401
+    DoubleBufferedScorer,
+    MicrobatchAssembler,
+)
+from realtime_fraud_detection_tpu.stream.job import JobConfig, StreamJob  # noqa: F401

@@ -1,0 +1,121 @@
+"""Deadline-bounded microbatch assembly: the stream→device seam.
+
+The reference configured (but never exercised) TF-Serving batching with
+max_batch 128 / 100 ms timeout (ml-models-deployment.yaml:270-290) and
+otherwise scored batch=1 per HTTP request (main.py:235-248). Here the
+assembler is a first-class component: it drains a consumer/queue into
+microbatches closed by whichever comes first —
+
+- size: the batch reached ``max_batch`` (aligned with the compile-cached
+  bucket set, core/batching.BATCH_BUCKETS), or
+- deadline: ``max_delay_ms`` passed since the batch's FIRST record arrived
+  (the p99-latency budget knob from BASELINE.json: assemble+transfer+compute
+  must stay under 20 ms).
+
+A C++ lock-free ring-buffer implementation of the same interface lives in
+``native/`` (NativeMicrobatcher); this Python one is the reference
+implementation and the fallback.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, List, Optional
+
+from realtime_fraud_detection_tpu.stream.transport import Consumer, Record
+
+
+class MicrobatchAssembler:
+    """Pull-based assembler over a transport consumer."""
+
+    def __init__(
+        self,
+        consumer: Consumer,
+        max_batch: int = 256,
+        max_delay_ms: float = 5.0,
+        clock: Callable[[], float] = time.monotonic,
+        idle_sleep_s: float = 0.0005,
+    ):
+        self.consumer = consumer
+        self.max_batch = max_batch
+        self.max_delay_ms = max_delay_ms
+        self.clock = clock
+        self.idle_sleep_s = idle_sleep_s
+        self._pending: List[Record] = []
+        self._first_ts: Optional[float] = None
+        self.batches_emitted = 0
+        self.records_emitted = 0
+
+    def _deadline_passed(self) -> bool:
+        return (
+            self._first_ts is not None
+            and (self.clock() - self._first_ts) * 1000.0 >= self.max_delay_ms
+        )
+
+    def next_batch(self, block: bool = True,
+                   timeout_s: Optional[float] = None) -> List[Record]:
+        """Assemble the next microbatch.
+
+        Non-blocking mode returns [] when neither the size nor the deadline
+        condition holds yet. Blocking mode waits (bounded by ``timeout_s``)
+        until a batch closes or the wait times out with whatever is pending.
+        """
+        wait_start = self.clock()
+        while True:
+            if len(self._pending) < self.max_batch:
+                got = self.consumer.poll(self.max_batch - len(self._pending))
+                if got and self._first_ts is None:
+                    self._first_ts = self.clock()
+                self._pending.extend(got)
+
+            if len(self._pending) >= self.max_batch or (
+                self._pending and self._deadline_passed()
+            ):
+                return self._emit()
+
+            if not block:
+                return []
+            if timeout_s is not None and self.clock() - wait_start >= timeout_s:
+                return self._emit() if self._pending else []
+            time.sleep(self.idle_sleep_s)
+
+    def _emit(self) -> List[Record]:
+        batch, self._pending = self._pending[: self.max_batch], self._pending[self.max_batch:]
+        self._first_ts = self.clock() if self._pending else None
+        self.batches_emitted += 1
+        self.records_emitted += len(batch)
+        return batch
+
+    def flush(self) -> List[Record]:
+        """Close and return whatever is pending (drain-on-shutdown)."""
+        return self._emit() if self._pending else []
+
+
+class DoubleBufferedScorer:
+    """Overlap host assembly of batch N+1 with device compute of batch N.
+
+    The host→device pipelining analog of the reference's operator pipeline
+    (SURVEY.md §2.8: 'the PP analog is host→device pipelining'). The score
+    function returns device arrays; blocking on them is deferred one
+    iteration so assembly and compute overlap.
+    """
+
+    def __init__(self, score_fn: Callable[[List[Record]], Any]):
+        self.score_fn = score_fn
+        self._in_flight: Optional[tuple] = None
+
+    def submit(self, batch: List[Record]) -> Optional[tuple]:
+        """Submit a batch; returns the PREVIOUS (batch, result) now complete."""
+        import jax
+
+        done = None
+        if self._in_flight is not None:
+            prev_batch, prev_result = self._in_flight
+            jax.block_until_ready(prev_result)
+            done = (prev_batch, prev_result)
+        self._in_flight = (batch, self.score_fn(batch)) if batch else None
+        return done
+
+    def drain(self) -> Optional[tuple]:
+        return self.submit([])

@@ -1,0 +1,63 @@
+"""Topic contract: names, partition counts, retention/compaction classes.
+
+Mirror of the reference's Kafka topic contract (create-topics.sh:101-160):
+29 topics across core / behavioral / alert / stream-processing / analytics /
+test groups, RF=3 minISR=2 lz4 in the real deployment. The in-memory broker
+honors the same names and partition counts so partition-keyed ordering
+semantics match a real Kafka deployment.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class TopicSpec:
+    name: str
+    partitions: int
+    compacted: bool = False
+
+
+# (create-topics.sh:101-160)
+TOPIC_SPECS: tuple[TopicSpec, ...] = (
+    # core transaction flow
+    TopicSpec("payment-transactions", 12),
+    TopicSpec("transaction-enriched", 12),
+    TopicSpec("transaction-features", 12),
+    TopicSpec("fraud-predictions", 12),
+    TopicSpec("fraud-decisions", 6),
+    # compacted profile topics
+    TopicSpec("user-profiles", 6, compacted=True),
+    TopicSpec("merchant-profiles", 4, compacted=True),
+    # behavioral
+    TopicSpec("user-behavior", 8),
+    TopicSpec("session-events", 8),
+    TopicSpec("device-fingerprints", 4),
+    # alerts
+    TopicSpec("fraud-alerts", 6),
+    TopicSpec("high-risk-transactions", 6),
+    TopicSpec("manual-review-queue", 4),
+    # stream processing
+    TopicSpec("velocity-checks", 8),
+    TopicSpec("pattern-analysis", 8),
+    TopicSpec("geolocation-events", 6),
+    TopicSpec("merchant-analytics", 4),
+    # analytics / audit
+    TopicSpec("transaction-analytics", 6),
+    TopicSpec("model-metrics", 4),
+    TopicSpec("audit-log", 4),
+    # test topics (create-topics.sh:148-151)
+    TopicSpec("test-transactions", 2),
+    TopicSpec("model-experiments", 2),
+    TopicSpec("feature-experiments", 2),
+)
+
+TOPIC_BY_NAME = {t.name: t for t in TOPIC_SPECS}
+
+TRANSACTIONS = "payment-transactions"
+ENRICHED = "transaction-enriched"
+FEATURES = "transaction-features"
+PREDICTIONS = "fraud-predictions"
+DECISIONS = "fraud-decisions"
+ALERTS = "fraud-alerts"

@@ -1,0 +1,237 @@
+"""Streaming transport: partitioned in-memory broker + gated Kafka backend.
+
+The reference's data backbone is a 3-broker Kafka cluster with idempotent
+lz4 producers and read_committed consumers (config/kafka/*.properties,
+FraudDetectionJob.java:141-213). This module provides the same *semantics*
+behind one interface:
+
+- ``InMemoryBroker`` — partitioned, offset-addressed, consumer-group topic
+  log entirely in process. This is the test/dev/bench transport and the
+  SURVEY.md §4 "fake in-process transport" testing strategy. Supports
+  deterministic fault injection (drop/dup/delay) for failure-path tests.
+- ``KafkaTransport`` — thin adapter over kafka-python, import-gated because
+  the client library is not present in this image; the interface is the
+  contract, so swapping it in is a deployment choice, not a rewrite.
+
+Offset semantics (the exactly-once story, SURVEY.md §5.4): consumers read
+from their group's committed offset; commit happens only after downstream
+write-back, so a crash replays the tail. Replay-idempotence is provided by
+the scorer's transaction cache keyed on transaction_id.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Callable, Dict, Iterable, List, Mapping, Optional, Sequence
+
+import numpy as np
+
+from realtime_fraud_detection_tpu.stream.topics import TOPIC_SPECS, TopicSpec
+
+
+@dataclasses.dataclass
+class Record:
+    topic: str
+    partition: int
+    offset: int
+    key: Optional[str]
+    value: Any
+    timestamp: float
+
+
+@dataclasses.dataclass
+class FaultInjector:
+    """Deterministic transport fault injection (absent in the reference —
+    SURVEY.md §5.3 'fault injection: none').
+
+    A *drop* models an in-flight delivery failure: the record is withheld
+    from this poll AND the consumer position must not advance past it, so it
+    is re-delivered on the next poll (at-least-once preserved). A *duplicate*
+    models redelivery: the record appears twice in one poll.
+    """
+
+    drop_prob: float = 0.0
+    duplicate_prob: float = 0.0
+    seed: int = 0
+
+    def __post_init__(self) -> None:
+        self._rng = np.random.default_rng(self.seed)
+
+    def apply(self, records: List[Record]) -> tuple[List[Record], Optional[Record]]:
+        """Returns (delivered, first_dropped). Delivery truncates at the
+        first drop so the caller can rewind its position to it."""
+        out: List[Record] = []
+        for r in records:
+            u = self._rng.random()
+            if u < self.drop_prob:
+                return out, r
+            out.append(r)
+            if u > 1.0 - self.duplicate_prob:
+                out.append(r)
+        return out, None
+
+
+class _PartitionLog:
+    __slots__ = ("records", "lock")
+
+    def __init__(self) -> None:
+        self.records: List[Record] = []
+        self.lock = threading.Lock()
+
+
+class InMemoryBroker:
+    """Partitioned topic log with consumer groups, single process."""
+
+    def __init__(self, topics: Sequence[TopicSpec] = TOPIC_SPECS,
+                 auto_create_partitions: int = 4):
+        self._topics: Dict[str, List[_PartitionLog]] = {}
+        self._committed: Dict[tuple, int] = {}   # (group, topic, part) -> next offset
+        self._rr: Dict[str, int] = {}            # round-robin cursor per topic
+        self._lock = threading.Lock()
+        self._auto_partitions = auto_create_partitions
+        for t in topics:
+            self.create_topic(t.name, t.partitions)
+
+    # ------------------------------------------------------------- topology
+    def create_topic(self, name: str, partitions: int) -> None:
+        with self._lock:
+            if name not in self._topics:
+                self._topics[name] = [_PartitionLog() for _ in range(partitions)]
+
+    def _logs(self, topic: str) -> List[_PartitionLog]:
+        logs = self._topics.get(topic)
+        if logs is None:
+            self.create_topic(topic, self._auto_partitions)
+            logs = self._topics[topic]
+        return logs
+
+    def partitions(self, topic: str) -> int:
+        return len(self._logs(topic))
+
+    # -------------------------------------------------------------- produce
+    def produce(self, topic: str, value: Any, key: Optional[str] = None,
+                timestamp: Optional[float] = None) -> Record:
+        """Append one record; partition chosen by key hash (Kafka semantics:
+        same key -> same partition -> per-key ordering)."""
+        logs = self._logs(topic)
+        if key is not None:
+            part = hash(key) % len(logs)
+        else:  # unkeyed: round-robin, like Kafka's default partitioner
+            with self._lock:
+                part = self._rr.get(topic, 0) % len(logs)
+                self._rr[topic] = part + 1
+        log = logs[part]
+        with log.lock:
+            rec = Record(topic, part, len(log.records), key, value,
+                         timestamp if timestamp is not None else time.time())
+            log.records.append(rec)
+        return rec
+
+    def produce_batch(self, topic: str, values: Iterable[Any],
+                      key_fn: Optional[Callable[[Any], str]] = None) -> int:
+        n = 0
+        for v in values:
+            self.produce(topic, v, key_fn(v) if key_fn else None)
+            n += 1
+        return n
+
+    # -------------------------------------------------------------- consume
+    def consumer(self, topics: Sequence[str], group_id: str,
+                 faults: Optional[FaultInjector] = None) -> "Consumer":
+        return Consumer(self, list(topics), group_id, faults)
+
+    def end_offsets(self, topic: str) -> List[int]:
+        return [len(p.records) for p in self._logs(topic)]
+
+    def read(self, topic: str, partition: int, start: int, limit: int) -> List[Record]:
+        log = self._logs(topic)[partition]
+        with log.lock:
+            return log.records[start:start + limit]
+
+    # -------------------------------------------------------------- offsets
+    def committed(self, group: str, topic: str, partition: int) -> int:
+        return self._committed.get((group, topic, partition), 0)
+
+    def commit(self, group: str, offsets: Mapping[tuple, int]) -> None:
+        with self._lock:
+            for (topic, part), off in offsets.items():
+                key = (group, topic, part)
+                if off > self._committed.get(key, 0):
+                    self._committed[key] = off
+
+    def lag(self, group: str, topic: str) -> int:
+        return sum(
+            max(0, end - self.committed(group, topic, p))
+            for p, end in enumerate(self.end_offsets(topic))
+        )
+
+
+class Consumer:
+    """Offset-tracking consumer over the in-memory broker.
+
+    ``poll`` returns up to max_records across all assigned partitions from
+    the *position* (not yet committed); ``commit`` durably advances the
+    group offset. ``seek_to_committed`` rewinds to the last commit —
+    the crash-recovery path.
+    """
+
+    def __init__(self, broker: InMemoryBroker, topics: List[str],
+                 group_id: str, faults: Optional[FaultInjector] = None):
+        self.broker = broker
+        self.topics = topics
+        self.group_id = group_id
+        self.faults = faults
+        self._position: Dict[tuple, int] = {}
+        self.seek_to_committed()
+
+    def seek_to_committed(self) -> None:
+        self._position = {
+            (t, p): self.broker.committed(self.group_id, t, p)
+            for t in self.topics
+            for p in range(self.broker.partitions(t))
+        }
+
+    def poll(self, max_records: int = 256) -> List[Record]:
+        out: List[Record] = []
+        for (t, p), pos in self._position.items():
+            if len(out) >= max_records:
+                break
+            recs = self.broker.read(t, p, pos, max_records - len(out))
+            if not recs:
+                continue
+            if self.faults is not None:
+                recs, dropped = self.faults.apply(recs)
+                if dropped is not None:
+                    # position stops AT the dropped record: re-delivered on
+                    # the next poll, never silently lost past a commit
+                    self._position[(t, p)] = dropped.offset
+                    out.extend(recs)
+                    continue
+            if recs:
+                self._position[(t, p)] = recs[-1].offset + 1
+                out.extend(recs)
+        return out
+
+    def commit(self) -> None:
+        self.broker.commit(self.group_id, dict(self._position))
+
+    def lag(self) -> int:
+        return sum(self.broker.lag(self.group_id, t) for t in self.topics)
+
+
+class KafkaTransport:
+    """Adapter to a real Kafka cluster (import-gated; kafka-python is not in
+    this image). Mirrors the reference producer config: idempotent, acks=all,
+    lz4 (config/kafka/producer.properties)."""
+
+    def __init__(self, bootstrap_servers: str = "localhost:9092"):
+        try:
+            import kafka  # noqa: F401
+        except ImportError as e:  # pragma: no cover
+            raise ImportError(
+                "kafka-python is not installed in this environment; use "
+                "InMemoryBroker, or install kafka-python for a real cluster"
+            ) from e
+        self.bootstrap_servers = bootstrap_servers  # pragma: no cover

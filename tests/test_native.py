@@ -1,0 +1,124 @@
+"""Native (C++) microbatcher tests: correctness + concurrency."""
+
+import json
+import shutil
+import threading
+
+import pytest
+
+from realtime_fraud_detection_tpu.native import (
+    NativeMicrobatchQueue,
+    native_available,
+    native_build_error,
+)
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("g++") is None, reason="no C++ toolchain"
+)
+
+
+@pytest.fixture(scope="module")
+def _native():
+    if not native_available():
+        pytest.fail(f"native build failed: {native_build_error()}")
+
+
+def test_push_pop_roundtrip(_native):
+    q = NativeMicrobatchQueue(capacity=64, max_batch=8, max_delay_ms=1e9)
+    payloads = [json.dumps({"n": i}).encode() for i in range(8)]
+    for p in payloads:
+        assert q.push(p)
+    batch = q.next_batch()
+    assert batch == payloads
+    assert q.pending() == 0
+    q.close()
+
+
+def test_size_trigger_before_deadline(_native):
+    q = NativeMicrobatchQueue(capacity=256, max_batch=4, max_delay_ms=1e9)
+    for i in range(10):
+        q.push(f"r{i}".encode())
+    assert len(q.next_batch()) == 4
+    assert len(q.next_batch()) == 4
+    assert q.next_batch() == []      # 2 pending, no deadline, not full
+    assert q.pending() == 2
+    q.close()
+
+
+def test_deadline_trigger(_native):
+    q = NativeMicrobatchQueue(capacity=64, max_batch=256, max_delay_ms=5.0)
+    q.push(b"only-one")
+    # blocking poll longer than the deadline must flush the partial batch
+    batch = q.next_batch(block_ms=100)
+    assert batch == [b"only-one"]
+    q.close()
+
+
+def test_backpressure_when_full(_native):
+    q = NativeMicrobatchQueue(capacity=4, max_batch=4, max_delay_ms=1e9)
+    assert all(q.push(b"x") for _ in range(4))
+    assert not q.push(b"overflow")
+    assert q.stats()["dropped"] == 1
+    q.close()
+
+
+def test_oversized_payload_raises(_native):
+    q = NativeMicrobatchQueue(capacity=4, slot_bytes=16)
+    with pytest.raises(ValueError):
+        q.push(b"y" * 17)
+    q.close()
+
+
+def test_concurrent_producers_no_loss(_native):
+    """8 producer threads, one consumer; every record arrives exactly once."""
+    q = NativeMicrobatchQueue(capacity=8192, max_batch=128, max_delay_ms=1.0)
+    n_threads, per_thread = 8, 500
+    errors = []
+
+    def produce(tid):
+        for i in range(per_thread):
+            payload = f"{tid}:{i}".encode()
+            while not q.push(payload):
+                pass  # spin on backpressure
+
+    threads = [threading.Thread(target=produce, args=(t,)) for t in range(n_threads)]
+    for t in threads:
+        t.start()
+
+    seen = set()
+    expected = n_threads * per_thread
+    import time
+    t_end = time.monotonic() + 30.0
+    while len(seen) < expected and time.monotonic() < t_end:
+        for p in q.next_batch(block_ms=10):
+            key = p.decode()
+            if key in seen:
+                errors.append(f"duplicate {key}")
+            seen.add(key)
+    for t in threads:
+        t.join()
+    assert not errors
+    assert len(seen) == expected
+    q.close()
+
+
+def test_tsan_stress(tmp_path):
+    """Race-freedom under ThreadSanitizer (SURVEY.md §5.2 requirement)."""
+    import subprocess
+    from pathlib import Path
+
+    src_dir = Path(__file__).resolve().parent.parent / (
+        "realtime_fraud_detection_tpu/native"
+    )
+    binary = tmp_path / "stress_tsan"
+    build = subprocess.run(
+        ["g++", "-O1", "-g", "-std=c++17", "-fsanitize=thread", "-pthread",
+         str(src_dir / "stress_main.cpp"), "-o", str(binary)],
+        capture_output=True, text=True, timeout=120,
+    )
+    if build.returncode != 0:
+        pytest.skip(f"TSAN unavailable: {build.stderr[:200]}")
+    run = subprocess.run([str(binary)], capture_output=True, text=True,
+                         timeout=120)
+    assert run.returncode == 0, run.stdout + run.stderr
+    assert run.stdout.startswith("OK")
