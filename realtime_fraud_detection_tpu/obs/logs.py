@@ -1,0 +1,116 @@
+"""Structured logging: console + rotating JSON file, domain helpers.
+
+Parity with the reference's logging stack (logging_config.py:11-219): a
+``dictConfig``-driven setup with a human console handler and a rotating JSON
+file handler, plus structured helper functions (``log_prediction_result`` et
+al.). JSON encoding is a stdlib formatter here — no ``pythonjsonlogger``
+dependency.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import logging.config
+import logging.handlers
+import time
+from typing import Any, Dict, Mapping, Optional
+
+__all__ = [
+    "JsonFormatter",
+    "setup_logging",
+    "log_prediction_result",
+    "log_batch_scored",
+    "log_model_event",
+]
+
+_RESERVED = set(logging.LogRecord(
+    "", 0, "", 0, "", (), None).__dict__) | {"message", "asctime"}
+
+
+class JsonFormatter(logging.Formatter):
+    """One JSON object per line; extra record attrs become fields."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        out: Dict[str, Any] = {
+            "ts": round(record.created, 6),
+            "level": record.levelname,
+            "logger": record.name,
+            "message": record.getMessage(),
+        }
+        for k, v in record.__dict__.items():
+            if k not in _RESERVED and not k.startswith("_"):
+                out[k] = v
+        if record.exc_info:
+            out["exc"] = self.formatException(record.exc_info)
+        return json.dumps(out, default=str)
+
+
+def setup_logging(level: str = "INFO", json_file: Optional[str] = None,
+                  max_bytes: int = 10 * 1024 * 1024, backups: int = 3) -> None:
+    """Configure root logging (reference logging_config.py:11-93)."""
+    handlers: Dict[str, Any] = {
+        "console": {
+            "class": "logging.StreamHandler",
+            "formatter": "console",
+            "level": level,
+        },
+    }
+    if json_file:
+        handlers["json_file"] = {
+            "class": "logging.handlers.RotatingFileHandler",
+            "filename": json_file,
+            "maxBytes": max_bytes,
+            "backupCount": backups,
+            "formatter": "json",
+            "level": level,
+        }
+    logging.config.dictConfig({
+        "version": 1,
+        "disable_existing_loggers": False,
+        "formatters": {
+            "console": {
+                "format": "%(asctime)s %(levelname)-7s %(name)s  %(message)s",
+            },
+            "json": {"()": f"{__name__}.JsonFormatter"},
+        },
+        "handlers": handlers,
+        "root": {"level": level, "handlers": list(handlers)},
+    })
+
+
+def log_prediction_result(logger: logging.Logger, transaction_id: str,
+                          fraud_score: float, decision: str,
+                          processing_time_ms: float,
+                          extra: Optional[Mapping[str, Any]] = None) -> None:
+    """Structured per-prediction log (logging_config.py:145-219 analog)."""
+    logger.info(
+        "prediction",
+        extra={
+            "event": "prediction",
+            "transaction_id": transaction_id,
+            "fraud_score": round(float(fraud_score), 6),
+            "decision": decision,
+            "processing_time_ms": round(float(processing_time_ms), 3),
+            **(dict(extra) if extra else {}),
+        },
+    )
+
+
+def log_batch_scored(logger: logging.Logger, size: int, elapsed_ms: float,
+                     bucket: int) -> None:
+    logger.info(
+        "batch_scored",
+        extra={"event": "batch_scored", "size": size, "bucket": bucket,
+               "elapsed_ms": round(elapsed_ms, 3)},
+    )
+
+
+def log_model_event(logger: logging.Logger, model: str, event: str,
+                    **fields: Any) -> None:
+    """Model lifecycle events: loaded / reloaded / disabled / failed."""
+    logger.info(
+        "model_event",
+        extra={"event": event, "model": model, "ts_wall": time.time(),
+               **fields},
+    )

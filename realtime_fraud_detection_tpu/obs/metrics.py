@@ -1,0 +1,360 @@
+"""Self-contained metrics plane: counters/gauges/histograms + Prometheus text.
+
+Capability parity with the reference's MetricsCollector (metrics.py:36-432):
+per-model/per-decision prediction counters, latency histogram (1 ms–5 s
+buckets), fraud-score histogram, uptime/throughput gauges, a bounded in-memory
+window of recent predictions powering the JSON ``/metrics`` summaries, and a
+``reset`` hook "(for testing purposes)" (metrics.py:403-417).
+
+Implemented as our own tiny registry rather than ``prometheus_client`` so
+instances are isolated (no process-global REGISTRY leaking between tests or
+between a serving app and a stream job in one process) and the render path is
+deterministic. Text output follows the Prometheus exposition format, so the
+reference's scrape topology (prometheus.yml:14-90) points at
+``GET /metrics/prometheus`` unchanged.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, Iterable, List, Mapping, Optional, Sequence, Tuple
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsCollector",
+    "Registry",
+    "DEFAULT_LATENCY_BUCKETS",
+]
+
+# Reference latency buckets: 1 ms .. 5 s (metrics.py:74-78).
+DEFAULT_LATENCY_BUCKETS: Tuple[float, ...] = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+)
+SCORE_BUCKETS: Tuple[float, ...] = tuple(i / 10 for i in range(1, 10))
+
+
+def _fmt(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    if float(v).is_integer():
+        return str(int(v))
+    return repr(float(v))
+
+
+def _labels_key(labels: Mapping[str, str]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def _escape(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _render_labels(key: Tuple[Tuple[str, str], ...]) -> str:
+    if not key:
+        return ""
+    body = ",".join(f'{k}="{_escape(v)}"' for k, v in key)
+    return "{" + body + "}"
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help_text: str,
+                 labelnames: Sequence[str] = ()):
+        self.name = name
+        self.help = help_text
+        self.labelnames = tuple(labelnames)
+        self._lock = threading.Lock()
+
+    def render(self) -> List[str]:
+        raise NotImplementedError
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def __init__(self, name, help_text, labelnames=()):
+        super().__init__(name, help_text, labelnames)
+        self._values: Dict[Tuple[Tuple[str, str], ...], float] = {}
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        if amount < 0:
+            raise ValueError("counters only go up")
+        key = _labels_key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels: str) -> float:
+        return self._values.get(_labels_key(labels), 0.0)
+
+    def total(self) -> float:
+        with self._lock:
+            return sum(self._values.values())
+
+    def render(self) -> List[str]:
+        with self._lock:
+            items = sorted(self._values.items())
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} {self.kind}"]
+        if not items:
+            items = [((), 0.0)]
+        for key, v in items:
+            lines.append(f"{self.name}{_render_labels(key)} {_fmt(v)}")
+        return lines
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def __init__(self, name, help_text, labelnames=()):
+        super().__init__(name, help_text, labelnames)
+        self._values: Dict[Tuple[Tuple[str, str], ...], float] = {}
+
+    def set(self, value: float, **labels: str) -> None:
+        with self._lock:
+            self._values[_labels_key(labels)] = float(value)
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        key = _labels_key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def dec(self, amount: float = 1.0, **labels: str) -> None:
+        self.inc(-amount, **labels)
+
+    def value(self, **labels: str) -> float:
+        return self._values.get(_labels_key(labels), 0.0)
+
+    def render(self) -> List[str]:
+        with self._lock:
+            items = sorted(self._values.items()) or [((), 0.0)]
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} {self.kind}"]
+        for key, v in items:
+            lines.append(f"{self.name}{_render_labels(key)} {_fmt(v)}")
+        return lines
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name, help_text, labelnames=(),
+                 buckets: Sequence[float] = DEFAULT_LATENCY_BUCKETS):
+        super().__init__(name, help_text, labelnames)
+        self.buckets = tuple(sorted(buckets)) + (math.inf,)
+        self._counts: Dict[Tuple[Tuple[str, str], ...], List[int]] = {}
+        self._sums: Dict[Tuple[Tuple[str, str], ...], float] = {}
+        self._maxes: Dict[Tuple[Tuple[str, str], ...], float] = {}
+
+    def observe(self, value: float, **labels: str) -> None:
+        if not math.isfinite(value):
+            # NaN/inf would poison _sum forever; drop it so count stays
+            # consistent with the bucket lines (callers should catch
+            # non-finite scores upstream via record_error)
+            return
+        key = _labels_key(labels)
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * len(self.buckets))
+            for i, ub in enumerate(self.buckets):
+                if value <= ub:
+                    counts[i] += 1
+                    break
+            self._sums[key] = self._sums.get(key, 0.0) + float(value)
+            self._maxes[key] = max(self._maxes.get(key, value), value)
+
+    def count(self, **labels: str) -> int:
+        return sum(self._counts.get(_labels_key(labels), ()))
+
+    def sum(self, **labels: str) -> float:
+        return self._sums.get(_labels_key(labels), 0.0)
+
+    def quantile(self, q: float, **labels: str) -> float:
+        """Upper bound of the hit bucket; when the mass lands in the +Inf
+        bucket, the tracked max observation (never understates the tail)."""
+        key = _labels_key(labels)
+        counts = self._counts.get(key)
+        if not counts:
+            return 0.0
+        total = sum(counts)
+        target = q * total
+        acc = 0
+        for i, c in enumerate(counts):
+            acc += c
+            if acc >= target and c:
+                return self.buckets[i] if self.buckets[i] != math.inf \
+                    else self._maxes.get(key, self.buckets[-2])
+        return self._maxes.get(key, self.buckets[-2])
+
+    def render(self) -> List[str]:
+        with self._lock:
+            keys = sorted(self._counts) or [()]
+            lines = [f"# HELP {self.name} {self.help}",
+                     f"# TYPE {self.name} {self.kind}"]
+            for key in keys:
+                counts = self._counts.get(key, [0] * len(self.buckets))
+                cum = 0
+                for ub, c in zip(self.buckets, counts):
+                    cum += c
+                    lk = key + (("le", _fmt(ub)),)
+                    lines.append(f"{self.name}_bucket{_render_labels(lk)} {cum}")
+                lines.append(
+                    f"{self.name}_sum{_render_labels(key)} "
+                    f"{_fmt(self._sums.get(key, 0.0))}"
+                )
+                lines.append(f"{self.name}_count{_render_labels(key)} {cum}")
+        return lines
+
+
+class Registry:
+    """Named metric collection with Prometheus text rendering."""
+
+    def __init__(self) -> None:
+        self._metrics: Dict[str, _Metric] = {}
+        self._lock = threading.Lock()
+
+    def register(self, metric: _Metric) -> _Metric:
+        with self._lock:
+            if metric.name in self._metrics:
+                raise ValueError(f"duplicate metric {metric.name!r}")
+            self._metrics[metric.name] = metric
+        return metric
+
+    def counter(self, name, help_text, labelnames=()) -> Counter:
+        return self.register(Counter(name, help_text, labelnames))  # type: ignore[return-value]
+
+    def gauge(self, name, help_text, labelnames=()) -> Gauge:
+        return self.register(Gauge(name, help_text, labelnames))  # type: ignore[return-value]
+
+    def histogram(self, name, help_text, labelnames=(),
+                  buckets=DEFAULT_LATENCY_BUCKETS) -> Histogram:
+        return self.register(Histogram(name, help_text, labelnames, buckets))  # type: ignore[return-value]
+
+    def render(self) -> str:
+        with self._lock:
+            metrics = list(self._metrics.values())
+        lines: List[str] = []
+        for m in metrics:
+            lines.extend(m.render())
+        return "\n".join(lines) + "\n"
+
+
+class MetricsCollector:
+    """Domain metrics for the scoring plane (reference metrics.py:36-432).
+
+    Also keeps a bounded window of recent predictions so ``summary()`` can
+    compute the JSON ``/metrics`` payload (throughput over the last minute,
+    latency percentiles, decision mix) the way the reference's in-memory
+    deques do (metrics.py:238-297) — but guarded by one lock, not three.
+    """
+
+    def __init__(self, window: int = 10_000, clock=time.monotonic) -> None:
+        self.registry = Registry()
+        self._clock = clock
+        self._start = clock()
+        self._lock = threading.Lock()
+        self._recent: deque = deque(maxlen=window)  # (t, duration_s, score, decision)
+        self._total = 0
+        # per-second event counts for throughput: immune to the _recent cap,
+        # so 50k tps reads as 50k tps even with a 10k-entry latency window
+        self._sec_counts: deque = deque(maxlen=120)  # (int_second, count)
+
+        r = self.registry
+        self.predictions_total = r.counter(
+            "ml_predictions_total", "Total predictions served",
+            ("model", "decision"))
+        self.prediction_errors = r.counter(
+            "ml_prediction_errors_total", "Prediction failures", ("stage",))
+        self.prediction_duration = r.histogram(
+            "ml_prediction_duration_seconds", "End-to-end scoring latency")
+        self.fraud_score = r.histogram(
+            "ml_fraud_score", "Fraud score distribution", buckets=SCORE_BUCKETS)
+        self.batch_size = r.histogram(
+            "scoring_microbatch_size", "Scored microbatch sizes",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512))
+        self.batch_duration = r.histogram(
+            "scoring_microbatch_duration_seconds", "Per-microbatch latency")
+        self.active_models = r.gauge(
+            "ml_active_models", "Number of live ensemble branches")
+        self.uptime = r.gauge("ml_uptime_seconds", "Process uptime")
+        self.throughput = r.gauge(
+            "ml_throughput_tps", "Scored txns/sec over the last 60 s")
+        self.queue_depth = r.gauge(
+            "serving_queue_depth", "Requests waiting in the microbatcher")
+
+    # ------------------------------------------------------------- recording
+    def record_prediction(self, decision: str, fraud_score: float,
+                          duration_s: float,
+                          model_predictions: Optional[Mapping[str, float]] = None,
+                          ) -> None:
+        self.predictions_total.inc(model="ensemble", decision=decision)
+        for name in (model_predictions or {}):
+            self.predictions_total.inc(model=name, decision=decision)
+        self.prediction_duration.observe(duration_s)
+        self.fraud_score.observe(fraud_score)
+        now = self._clock()
+        with self._lock:
+            self._recent.append((now, duration_s, fraud_score, decision))
+            self._total += 1
+            sec = int(now)
+            if self._sec_counts and self._sec_counts[-1][0] == sec:
+                self._sec_counts[-1][1] += 1
+            else:
+                self._sec_counts.append([sec, 1])
+
+    def record_batch(self, size: int, duration_s: float) -> None:
+        self.batch_size.observe(size)
+        self.batch_duration.observe(duration_s)
+
+    def record_error(self, stage: str = "predict") -> None:
+        self.prediction_errors.inc(stage=stage)
+
+    # ------------------------------------------------------------- summaries
+    def summary(self) -> Dict[str, Any]:
+        """JSON metrics payload (reference ``GET /metrics``, main.py:268-288)."""
+        now = self._clock()
+        self.uptime.set(now - self._start)
+        with self._lock:
+            recent = list(self._recent)
+            in_window = sum(c for s, c in self._sec_counts if now - s <= 60.0)
+        tps = in_window / 60.0
+        self.throughput.set(tps)
+        durations = sorted(r[1] for r in recent)
+        decisions: Dict[str, int] = {}
+        for _, _, _, d in recent:
+            decisions[d] = decisions.get(d, 0) + 1
+
+        def pct(q: float) -> float:
+            if not durations:
+                return 0.0
+            return durations[min(int(q * len(durations)), len(durations) - 1)]
+
+        return {
+            "uptime_seconds": now - self._start,
+            "total_predictions": self._total,
+            "recent_predictions": len(recent),
+            "throughput_tps_60s": tps,
+            "latency_ms": {
+                "p50": pct(0.50) * 1e3,
+                "p95": pct(0.95) * 1e3,
+                "p99": pct(0.99) * 1e3,
+            },
+            "avg_fraud_score": (
+                sum(r[2] for r in recent) / len(recent) if recent else 0.0),
+            "decision_counts": decisions,
+            "errors": int(self.prediction_errors.total()),
+        }
+
+    def render_prometheus(self) -> str:
+        self.uptime.set(self._clock() - self._start)
+        return self.registry.render()
+
+    def reset(self) -> None:
+        """Drop windowed state (reference reset_metrics, metrics.py:403-417)."""
+        with self._lock:
+            self._recent.clear()
+            self._sec_counts.clear()

@@ -1,0 +1,90 @@
+"""Profiling: jax.profiler traces + cheap wall-clock span accounting.
+
+Replaces the reference's coarse timing-threaded-through-results approach
+(SURVEY.md §5.1: per-request processing_time_ms at main.py:160-169, per-model
+timing at ensemble_predictor.py:185-215) with two proper layers:
+
+- ``device_trace``: a real ``jax.profiler`` trace you can open in
+  TensorBoard/Perfetto — shows XLA fusion, HBM traffic, collective overlap.
+- ``SpanTimer``: near-zero-overhead named wall-clock spans with aggregate
+  stats (count/total/p50/p99) for the host-side hot path, where a full
+  profiler would distort the 5–10 ms microbatch deadline.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from collections import deque
+from typing import Dict, Iterator, Optional
+
+__all__ = ["device_trace", "SpanTimer", "annotate"]
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: str) -> Iterator[None]:
+    """Capture a jax.profiler trace for the enclosed block."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Named region visible in device traces (TraceAnnotation)."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+class SpanTimer:
+    """Aggregating span timer for host-side stages of the scoring seam."""
+
+    def __init__(self, clock=time.perf_counter, max_samples: int = 10_000):
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._max = max_samples      # per-span cap: hot-path safe, O(1) memory
+        self._spans: Dict[str, deque] = {}
+
+    @contextlib.contextmanager
+    def span(self, name: str) -> Iterator[None]:
+        t0 = self._clock()
+        try:
+            yield
+        finally:
+            dt = self._clock() - t0
+            self.record(name, dt)
+
+    def record(self, name: str, seconds: float) -> None:
+        with self._lock:
+            self._spans.setdefault(
+                name, deque(maxlen=self._max)).append(seconds)
+
+    def stats(self, name: Optional[str] = None) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            names = [name] if name else list(self._spans)
+            out: Dict[str, Dict[str, float]] = {}
+            for n in names:
+                xs = sorted(self._spans.get(n, ()))
+                if not xs:
+                    continue
+                out[n] = {
+                    "count": len(xs),
+                    "total_s": sum(xs),
+                    "mean_ms": 1e3 * sum(xs) / len(xs),
+                    "p50_ms": 1e3 * xs[len(xs) // 2],
+                    "p99_ms": 1e3 * xs[min(int(0.99 * len(xs)),
+                                           len(xs) - 1)],
+                    "max_ms": 1e3 * xs[-1],
+                }
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._spans.clear()

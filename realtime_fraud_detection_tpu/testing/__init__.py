@@ -1,0 +1,10 @@
+"""Experimentation utilities (reference src/testing/ab_testing.py parity)."""
+
+from realtime_fraud_detection_tpu.testing.ab import (
+    ABTestManager,
+    Experiment,
+    Variant,
+    VariantStats,
+)
+
+__all__ = ["ABTestManager", "Experiment", "Variant", "VariantStats"]

@@ -1,0 +1,98 @@
+"""A/B experimentation: sticky routing, per-arm metrics, significance."""
+
+import pytest
+
+from realtime_fraud_detection_tpu.testing import ABTestManager, Variant
+
+
+def two_arm(mgr, name="exp", split=0.5, salt=""):
+    return mgr.create_experiment(name, [
+        Variant("control", split, {}),
+        Variant("treatment", 1 - split,
+                {"weights": {"bert_text": 0.3}}),
+    ], salt=salt)
+
+
+class TestRouting:
+    def test_assignment_is_sticky(self):
+        mgr = ABTestManager()
+        two_arm(mgr)
+        first = mgr.assign("exp", "user_42").name
+        for _ in range(10):
+            assert mgr.assign("exp", "user_42").name == first
+
+    def test_split_approximates_traffic(self):
+        mgr = ABTestManager()
+        two_arm(mgr, split=0.8)
+        n = 5000
+        control = sum(
+            mgr.assign("exp", f"u{i}").name == "control" for i in range(n))
+        assert 0.77 < control / n < 0.83
+
+    def test_salt_reshuffles_assignment(self):
+        a, b = ABTestManager(), ABTestManager()
+        two_arm(a, salt="s1")
+        two_arm(b, salt="s2")
+        users = [f"u{i}" for i in range(200)]
+        same = sum(a.assign("exp", u).name == b.assign("exp", u).name
+                   for u in users)
+        assert same < 200                     # at least some users moved
+
+    def test_traffic_must_sum_to_one(self):
+        mgr = ABTestManager()
+        with pytest.raises(ValueError):
+            mgr.create_experiment("bad", [Variant("a", 0.5), Variant("b", 0.4)])
+
+    def test_traffic_must_be_in_unit_range(self):
+        mgr = ABTestManager()
+        with pytest.raises(ValueError):
+            mgr.create_experiment(
+                "bad2", [Variant("a", -0.5), Variant("b", 1.5)])
+
+    def test_inactive_experiment_routes_nothing(self):
+        mgr = ABTestManager()
+        two_arm(mgr)
+        mgr.stop_experiment("exp")
+        assert mgr.route_config_overrides("exp", "u1") == {}
+
+
+class TestEvaluation:
+    def test_per_variant_metrics(self):
+        mgr = ABTestManager()
+        two_arm(mgr)
+        # control: catches 2 of 4 frauds, 1 false positive on 4 legit
+        for flagged, actual in [(True, True), (True, True), (False, True),
+                                (False, True), (True, False), (False, False),
+                                (False, False), (False, False)]:
+            mgr.record_prediction("exp", "control", 0.5, flagged, actual)
+        m = mgr.results("exp")["variants"]["control"]
+        assert m["labeled"] == 8
+        assert m["recall"] == pytest.approx(0.5)
+        assert m["precision"] == pytest.approx(2 / 3)
+
+    def test_significance_detects_large_effect(self):
+        mgr = ABTestManager()
+        two_arm(mgr)
+        for _ in range(100):   # control recall 0.5
+            mgr.record_prediction("exp", "control", 0.5, True, True)
+            mgr.record_prediction("exp", "control", 0.5, False, True)
+        for _ in range(190):   # treatment recall 0.95
+            mgr.record_prediction("exp", "treatment", 0.5, True, True)
+        for _ in range(10):
+            mgr.record_prediction("exp", "treatment", 0.5, False, True)
+        sig = mgr.results("exp")["significance"]
+        assert sig["computed"] and sig["significant"]
+        assert sig["effect"] == pytest.approx(0.45)
+
+    def test_significance_requires_labels(self):
+        mgr = ABTestManager()
+        two_arm(mgr)
+        mgr.record_prediction("exp", "control", 0.4, False)
+        sig = mgr.results("exp")["significance"]
+        assert not sig["computed"]
+
+    def test_overrides_flow_through_routing(self):
+        mgr = ABTestManager()
+        two_arm(mgr, split=0.0)               # everyone → treatment
+        ov = mgr.route_config_overrides("exp", "anyone")
+        assert ov == {"weights": {"bert_text": 0.3}}

@@ -1,0 +1,231 @@
+"""Observability plane: metrics registry, drift monitor, logs, span timer."""
+
+import json
+import logging
+
+import numpy as np
+import pytest
+
+from realtime_fraud_detection_tpu.obs import (
+    DriftConfig,
+    FeatureDriftMonitor,
+    JsonFormatter,
+    MetricsCollector,
+    Registry,
+    SpanTimer,
+    log_prediction_result,
+)
+
+
+class TestRegistry:
+    def test_counter_labels_and_total(self):
+        r = Registry()
+        c = r.counter("preds_total", "predictions", ("model", "decision"))
+        c.inc(model="xgb", decision="APPROVE")
+        c.inc(2, model="xgb", decision="DECLINE")
+        assert c.value(model="xgb", decision="APPROVE") == 1
+        assert c.total() == 3
+
+    def test_counter_rejects_negative(self):
+        c = Registry().counter("c", "h")
+        with pytest.raises(ValueError):
+            c.inc(-1)
+
+    def test_gauge_set_inc_dec(self):
+        g = Registry().gauge("g", "h")
+        g.set(5)
+        g.inc(2)
+        g.dec()
+        assert g.value() == 6
+
+    def test_histogram_buckets_and_quantile(self):
+        h = Registry().histogram("h", "lat", buckets=(0.01, 0.1, 1.0))
+        for v in [0.005] * 98 + [0.5, 0.5]:
+            h.observe(v)
+        assert h.count() == 100
+        assert h.quantile(0.5) == 0.01
+        assert h.quantile(0.99) == pytest.approx(1.0)
+
+    def test_prometheus_text_format(self):
+        r = Registry()
+        c = r.counter("x_total", "things", ("k",))
+        c.inc(k="v")
+        h = r.histogram("lat_seconds", "latency", buckets=(0.1, 1.0))
+        h.observe(0.05)
+        text = r.render()
+        assert "# TYPE x_total counter" in text
+        assert 'x_total{k="v"} 1' in text
+        assert 'lat_seconds_bucket{le="0.1"} 1' in text
+        assert 'lat_seconds_bucket{le="+Inf"} 1' in text
+        assert "lat_seconds_count 1" in text
+
+    def test_non_finite_observation_dropped(self):
+        h = Registry().histogram("h", "lat", buckets=(0.1, 1.0))
+        h.observe(float("nan"))
+        h.observe(float("inf"))
+        h.observe(0.05)
+        assert h.count() == 1
+        assert h.sum() == pytest.approx(0.05)
+        sum_line = [ln for ln in h.render() if "_sum" in ln][0]
+        assert "nan" not in sum_line and "inf" not in sum_line.lower()
+
+    def test_quantile_in_overflow_bucket_reports_max(self):
+        h = Registry().histogram("h", "lat", buckets=(0.1, 1.0))
+        for _ in range(10):
+            h.observe(60.0)
+        assert h.quantile(0.99) == pytest.approx(60.0)
+
+    def test_label_values_escaped(self):
+        c = Registry().counter("c_total", "h", ("k",))
+        c.inc(k='say "hi"\nnewline\\slash')
+        line = [ln for ln in c.render() if ln.startswith("c_total{")][0]
+        assert '\\"hi\\"' in line and "\\n" in line and "\\\\" in line
+        assert "\n" not in line
+
+    def test_duplicate_name_rejected(self):
+        r = Registry()
+        r.counter("dup", "h")
+        with pytest.raises(ValueError):
+            r.gauge("dup", "h")
+
+
+class TestMetricsCollector:
+    def test_record_and_summary(self):
+        t = [0.0]
+        m = MetricsCollector(clock=lambda: t[0])
+        for i in range(10):
+            t[0] = float(i)
+            m.record_prediction(
+                "APPROVE" if i < 8 else "DECLINE",
+                fraud_score=0.1 * i, duration_s=0.004,
+                model_predictions={"xgboost_primary": 0.2},
+            )
+        s = m.summary()
+        assert s["total_predictions"] == 10
+        assert s["decision_counts"] == {"APPROVE": 8, "DECLINE": 2}
+        assert s["throughput_tps_60s"] == pytest.approx(10 / 60.0)
+        assert s["latency_ms"]["p99"] <= 5.0 + 1e-9
+        assert m.predictions_total.value(
+            model="xgboost_primary", decision="APPROVE") == 8
+
+    def test_prometheus_render_includes_domain_metrics(self):
+        m = MetricsCollector()
+        m.record_prediction("REVIEW", 0.9, 0.002)
+        m.record_error("assemble")
+        text = m.render_prometheus()
+        assert 'ml_predictions_total{decision="REVIEW",model="ensemble"} 1' in text
+        assert 'ml_prediction_errors_total{stage="assemble"} 1' in text
+
+    def test_throughput_not_capped_by_latency_window(self):
+        t = [0.0]
+        m = MetricsCollector(window=100, clock=lambda: t[0])
+        for i in range(1000):           # 1000 events in 10 "seconds"
+            t[0] = i / 100.0
+            m.record_prediction("APPROVE", 0.1, 0.001)
+        s = m.summary()
+        assert s["throughput_tps_60s"] == pytest.approx(1000 / 60.0)
+        assert s["recent_predictions"] == 100   # latency window stays capped
+
+    def test_batch_duration_recorded(self):
+        m = MetricsCollector()
+        m.record_batch(32, 0.008)
+        assert m.batch_duration.count() == 1
+        assert m.batch_duration.sum() == pytest.approx(0.008)
+
+    def test_reset_clears_window_not_counters(self):
+        m = MetricsCollector()
+        m.record_prediction("APPROVE", 0.1, 0.001)
+        m.reset()
+        s = m.summary()
+        assert s["recent_predictions"] == 0
+        assert s["throughput_tps_60s"] == 0.0
+        assert m.predictions_total.total() > 0
+
+
+class TestDrift:
+    def _warm(self, mon, rng, rows, loc=0.0, scale=1.0):
+        mon.update(rng.normal(loc, scale, size=(rows, 8)))
+
+    def test_no_drift_on_same_distribution(self):
+        rng = np.random.default_rng(0)
+        mon = FeatureDriftMonitor(DriftConfig(num_features=8,
+                                              warmup_rows=1000,
+                                              window_rows=1000))
+        self._warm(mon, rng, 1200)
+        assert mon.baseline_frozen
+        self._warm(mon, rng, 1000)
+        rep = mon.report()
+        assert not rep.drifted
+        assert rep.max_psi < 0.1
+
+    def test_detects_mean_shift(self):
+        rng = np.random.default_rng(1)
+        mon = FeatureDriftMonitor(DriftConfig(num_features=8,
+                                              warmup_rows=1000,
+                                              window_rows=1000))
+        self._warm(mon, rng, 1200)
+        shifted = rng.normal(0, 1, size=(1000, 8))
+        shifted[:, 3] += 3.0                       # feature 3 drifts hard
+        mon.update(shifted)
+        rep = mon.report()
+        assert rep.drifted
+        assert 3 in rep.top_features
+        assert rep.psi[3] > 0.25
+        assert rep.psi[0] < 0.25
+
+    def test_report_before_freeze_is_quiet(self):
+        mon = FeatureDriftMonitor(DriftConfig(num_features=4, warmup_rows=100))
+        mon.update(np.zeros((10, 4)))
+        rep = mon.report()
+        assert not rep.drifted and not rep.baseline_frozen
+
+    def test_shape_validation(self):
+        mon = FeatureDriftMonitor(DriftConfig(num_features=4))
+        with pytest.raises(ValueError):
+            mon.update(np.zeros((10, 5)))
+
+    def test_tiny_window_does_not_false_alarm(self):
+        rng = np.random.default_rng(2)
+        mon = FeatureDriftMonitor(DriftConfig(num_features=8,
+                                              warmup_rows=500,
+                                              window_rows=500,
+                                              min_report_rows=200))
+        mon.update(rng.normal(size=(600, 8)))
+        mon.update(rng.normal(size=(1, 8)))       # near-empty window
+        rep = mon.report()
+        assert not rep.drifted and rep.max_psi == 0.0
+
+
+class TestLogs:
+    def test_json_formatter_fields(self):
+        rec = logging.LogRecord("t", logging.INFO, __file__, 1, "hello",
+                                (), None)
+        rec.transaction_id = "tx1"
+        out = json.loads(JsonFormatter().format(rec))
+        assert out["message"] == "hello"
+        assert out["transaction_id"] == "tx1"
+        assert out["level"] == "INFO"
+
+    def test_log_prediction_result_structured(self, caplog):
+        logger = logging.getLogger("test.pred")
+        with caplog.at_level(logging.INFO, logger="test.pred"):
+            log_prediction_result(logger, "tx9", 0.87, "REVIEW", 3.2)
+        rec = caplog.records[-1]
+        assert rec.transaction_id == "tx9"
+        assert rec.decision == "REVIEW"
+        assert rec.fraud_score == pytest.approx(0.87)
+
+
+class TestSpanTimer:
+    def test_span_stats(self):
+        t = [0.0]
+        timer = SpanTimer(clock=lambda: t[0])
+        for dt in (0.001, 0.002, 0.010):
+            with timer.span("assemble"):
+                t[0] += dt
+        st = timer.stats("assemble")["assemble"]
+        assert st["count"] == 3
+        assert st["max_ms"] == pytest.approx(10.0)
+        assert st["total_s"] == pytest.approx(0.013)
+        timer.reset()
+        assert timer.stats() == {}
