@@ -1,0 +1,194 @@
+"""Checkpoint / resume: params via orbax, host state via pickle, offsets JSON.
+
+The reference's only real recovery mechanism is Flink checkpointing — RocksDB
+operator state + Kafka offsets, 10 s interval, EXACTLY_ONCE
+(FraudDetectionJob.java:112-136, docker-compose.yml:270-276); the ML service
+has no model-state checkpointing at all, just immutable files + hot reload
+(main.py:291-305). This module covers both roles TPU-natively (SURVEY.md §5.4):
+
+- **device state** (model params / optimizer state — any JAX pytree) goes
+  through orbax's StandardCheckpointer, sharding-aware and async-safe;
+- **host state** (the scorer's velocity windows, user history ring buffers,
+  entity graph, profile caches — the RocksDB analog) is pickled;
+- **offsets** (the transport's committed positions — the source of truth for
+  effectively-once scoring, SURVEY.md §5.4) land in a JSON manifest.
+
+Layout:  <dir>/step_<N>/{params/, host_state.pkl, manifest.json}
+with keep-N retention and a ``latest_step`` probe; ``restore`` of a partial
+checkpoint (params-only, say) returns None for the missing parts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import pickle
+import shutil
+import time
+from pathlib import Path
+from typing import Any, Dict, Mapping, Optional
+
+__all__ = [
+    "Checkpoint",
+    "CheckpointManager",
+    "snapshot_scorer_host_state",
+    "restore_scorer_host_state",
+]
+
+_MANIFEST = "manifest.json"
+_HOST_STATE = "host_state.pkl"
+_PARAMS = "params"
+
+# One process-wide checkpointer: orbax Checkpointer instances own async I/O
+# machinery whose finalizer (on GC of a short-lived instance) tears down a
+# shared executor and breaks every later save/restore in the process.
+_CHECKPOINTER = None
+
+
+def _orbax_checkpointer():
+    global _CHECKPOINTER
+    if _CHECKPOINTER is None:
+        import orbax.checkpoint as ocp
+
+        _CHECKPOINTER = ocp.StandardCheckpointer()
+    return _CHECKPOINTER
+
+
+@dataclasses.dataclass
+class Checkpoint:
+    step: int
+    params: Any = None
+    host_state: Any = None
+    offsets: Optional[Dict[str, Any]] = None
+    metadata: Optional[Dict[str, Any]] = None
+
+
+class CheckpointManager:
+    """Save/restore/retain checkpoints under one directory."""
+
+    def __init__(self, directory: str | Path, keep: int = 3):
+        self.directory = Path(directory)
+        self.directory.mkdir(parents=True, exist_ok=True)
+        self.keep = keep
+
+    # ------------------------------------------------------------- plumbing
+    @staticmethod
+    def _orbax():
+        return _orbax_checkpointer()
+
+    def _step_dir(self, step: int) -> Path:
+        return self.directory / f"step_{step:010d}"
+
+    def steps(self) -> list[int]:
+        out = []
+        for p in self.directory.glob("step_*"):
+            if (p / _MANIFEST).exists():       # incomplete saves don't count
+                out.append(int(p.name.split("_")[1]))
+        return sorted(out)
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.steps()
+        return steps[-1] if steps else None
+
+    # ----------------------------------------------------------------- save
+    def save(self, step: int, params: Any = None, host_state: Any = None,
+             offsets: Optional[Mapping[str, Any]] = None,
+             metadata: Optional[Mapping[str, Any]] = None) -> Path:
+        """Write one checkpoint. The manifest is written LAST — a crash
+        mid-save leaves a directory without a manifest, which ``steps()``
+        ignores and the next ``save`` overwrites."""
+        d = self._step_dir(step)
+        if d.exists():
+            shutil.rmtree(d)                   # overwrite a torn save
+        d.mkdir(parents=True)
+        if params is not None:
+            # StandardCheckpointer wants the target dir absent
+            self._orbax().save(str((d / _PARAMS).absolute()), params)
+        if host_state is not None:
+            with open(d / _HOST_STATE, "wb") as f:
+                pickle.dump(host_state, f, protocol=pickle.HIGHEST_PROTOCOL)
+        manifest = {
+            "step": step,
+            "wall_time": time.time(),
+            "has_params": params is not None,
+            "has_host_state": host_state is not None,
+            "offsets": dict(offsets) if offsets is not None else None,
+            "metadata": dict(metadata) if metadata is not None else None,
+        }
+        with open(d / _MANIFEST, "w") as f:
+            json.dump(manifest, f, indent=1)
+        self._retain()
+        return d
+
+    def _retain(self) -> None:
+        steps = self.steps()
+        for s in steps[: max(0, len(steps) - self.keep)]:
+            shutil.rmtree(self._step_dir(s), ignore_errors=True)
+
+    # -------------------------------------------------------------- restore
+    def restore(self, step: Optional[int] = None,
+                params_template: Any = None) -> Checkpoint:
+        """Load a checkpoint (latest if ``step`` is None).
+
+        ``params_template`` — a pytree with the target structure/shapes
+        (e.g. a freshly-initialized ScoringModels); required to restore
+        params, ignored otherwise.
+        """
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(
+                    f"no checkpoints under {self.directory}")
+        d = self._step_dir(step)
+        with open(d / _MANIFEST) as f:
+            manifest = json.load(f)
+
+        params = None
+        if manifest["has_params"]:
+            if params_template is None:
+                raise ValueError(
+                    "checkpoint has params; pass params_template to restore")
+            params = self._orbax().restore(
+                str((d / _PARAMS).absolute()), target=params_template)
+        host_state = None
+        if manifest["has_host_state"]:
+            with open(d / _HOST_STATE, "rb") as f:
+                host_state = pickle.load(f)
+        return Checkpoint(
+            step=manifest["step"],
+            params=params,
+            host_state=host_state,
+            offsets=manifest.get("offsets"),
+            metadata=manifest.get("metadata"),
+        )
+
+
+# --------------------------------------------------------------------------
+# FraudScorer integration: host-state snapshot = the RocksDB analog
+# --------------------------------------------------------------------------
+
+def snapshot_scorer_host_state(scorer) -> Dict[str, Any]:
+    """Pickle-able snapshot of a FraudScorer's streaming state (velocity
+    windows, per-user history, entity graph/indexes, profiles, txn cache —
+    everything the reference kept in Redis/RocksDB, SURVEY.md §2.5)."""
+    return {
+        "profiles": scorer.profiles,
+        "velocity": scorer.velocity,
+        "history": scorer.history,
+        "graph": scorer.graph,
+        "txn_cache": scorer.txn_cache,
+        "users_index": scorer._users,
+        "merchants_index": scorer._merchants,
+        "stats": dict(scorer.stats),
+    }
+
+
+def restore_scorer_host_state(scorer, state: Mapping[str, Any]) -> None:
+    scorer.profiles = state["profiles"]
+    scorer.velocity = state["velocity"]
+    scorer.history = state["history"]
+    scorer.graph = state["graph"]
+    scorer.txn_cache = state["txn_cache"]
+    scorer._users = state["users_index"]
+    scorer._merchants = state["merchants_index"]
+    scorer.stats.update(state["stats"])

@@ -175,6 +175,15 @@ class FraudScorer:
                       merchants: Mapping[str, Mapping[str, Any]]) -> None:
         self.profiles.seed(users, merchants)
 
+    # ----------------------------------------------------------------- models
+    def set_models(self, models: ScoringModels) -> None:
+        """Swap the model set (hot reload). Params are replicated onto this
+        scorer's mesh — arrays restored from checkpoint arrive committed to
+        one device, which would clash with mesh-sharded batch arguments."""
+        from realtime_fraud_detection_tpu.core.mesh import replicated_sharding
+
+        self.models = jax.device_put(models, replicated_sharding(self.mesh))
+
     # ---------------------------------------------------------------- assembly
     def assemble(self, records: Sequence[Mapping[str, Any]],
                  now: Optional[float] = None) -> ScoreBatch:

@@ -1,0 +1,21 @@
+"""Serving layer: the §2.7 HTTP API over the microbatched fused scorer."""
+
+from realtime_fraud_detection_tpu.serving.app import ServingApp
+from realtime_fraud_detection_tpu.serving.batcher import RequestMicrobatcher
+from realtime_fraud_detection_tpu.serving.httpd import (
+    HttpError,
+    HttpServer,
+)
+from realtime_fraud_detection_tpu.serving.validation import (
+    validate_batch,
+    validate_transaction,
+)
+
+__all__ = [
+    "HttpError",
+    "HttpServer",
+    "RequestMicrobatcher",
+    "ServingApp",
+    "validate_batch",
+    "validate_transaction",
+]

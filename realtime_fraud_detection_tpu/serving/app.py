@@ -1,0 +1,311 @@
+"""The scoring service: §2.7 API surface over the microbatched TPU scorer.
+
+Endpoint parity with the reference FastAPI app (main.py:127-343):
+
+    POST /predict             one transaction  -> FraudPrediction
+    POST /batch-predict       list             -> {results, count, ...}
+    GET  /health              liveness + model inventory
+    GET  /metrics             JSON summary (throughput/latency/decisions)
+    GET  /model-info          ensemble weights/strategy/mesh
+    POST /reload-models       hot swap (from checkpoint dir or fresh init)
+    GET  /metrics/prometheus  text exposition
+
+plus capabilities the reference only promised:
+
+    GET  /drift               feature drift report (config.py:110-116)
+    POST /experiments         create an A/B experiment (ab_testing.py analog)
+    GET  /experiments?name=   arm metrics + significance
+
+The difference from the reference is the execution model: every concurrent
+/predict coalesces through RequestMicrobatcher into ONE fused XLA program
+call, instead of 5 asyncio tasks per request at batch=1
+(ensemble_predictor.py:166-182), and /batch-predict scores the whole list in
+bucketed dense batches instead of a sequential loop (main.py:235-248).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from realtime_fraud_detection_tpu.checkpoint import (
+    CheckpointManager,
+    restore_scorer_host_state,
+)
+from realtime_fraud_detection_tpu.obs import (
+    DriftConfig,
+    FeatureDriftMonitor,
+    MetricsCollector,
+)
+from realtime_fraud_detection_tpu.scoring import init_scoring_models
+from realtime_fraud_detection_tpu.scoring.scorer import FraudScorer
+from realtime_fraud_detection_tpu.serving.batcher import RequestMicrobatcher
+from realtime_fraud_detection_tpu.serving.httpd import HttpError, HttpServer
+from realtime_fraud_detection_tpu.serving.validation import (
+    validate_batch,
+    validate_transaction,
+)
+from realtime_fraud_detection_tpu.testing import (
+    ABTestManager,
+    Variant,
+    apply_weight_overrides,
+)
+from realtime_fraud_detection_tpu.utils.config import Config
+
+__all__ = ["ServingApp"]
+
+
+class ServingApp:
+    """Wire scorer + batcher + obs + experiments behind the HTTP surface."""
+
+    def __init__(self, config: Optional[Config] = None,
+                 scorer: Optional[FraudScorer] = None,
+                 host: Optional[str] = None, port: Optional[int] = None):
+        self.config = config or Config()
+        sc = self.config.serving
+        self.scorer = scorer if scorer is not None else FraudScorer(self.config)
+        self.metrics = MetricsCollector()
+        self.drift = FeatureDriftMonitor(DriftConfig(
+            num_features=self.scorer.sc.feature_dim))
+        self.ab = ABTestManager()
+        self.batcher = RequestMicrobatcher(
+            self._score_batch_sync,
+            max_batch=sc.microbatch_max_size,
+            deadline_ms=sc.microbatch_deadline_ms,
+        )
+        self.http = HttpServer(host if host is not None else sc.host,
+                               port if port is not None else sc.port)
+        self._reload_lock = asyncio.Lock()
+        # FraudScorer and the drift monitor are single-writer; /predict's
+        # microbatcher thread and /batch-predict's executor thread both call
+        # _score_batch_sync, so serialize them (the device is serial anyway)
+        self._score_lock = threading.Lock()
+        self._started = time.monotonic()
+        self._register_routes()
+
+    # --------------------------------------------------------------- scoring
+    def _score_batch_sync(self, txns) -> List[Dict[str, Any]]:
+        """Runs in an executor thread: device call + obs write-back."""
+        with self._score_lock:
+            t0 = time.perf_counter()
+            try:
+                results = self.scorer.score_batch(txns)
+            except Exception:
+                self.metrics.record_error("score")
+                raise
+            dt = time.perf_counter() - t0
+            self.metrics.record_batch(len(results), dt)
+            if self.config.monitoring.enable_drift_detection:
+                self.drift.update(self.scorer.last_features)
+        self._apply_experiments(txns, results)
+        per_txn = dt / max(len(results), 1)
+        for r in results:
+            self.metrics.record_prediction(
+                r["decision"], r["fraud_score"], per_txn,
+                r["model_predictions"])
+        return results
+
+    def _apply_experiments(self, txns, results) -> None:
+        """Route each txn through active experiments: treatment overrides
+        re-weight the ensemble host-side (a weighted average over the 5
+        returned model predictions — numerically identical to running the
+        device combine with those weights), and every arm accumulates
+        online metrics. Ground-truth labels, when the producer supplies
+        them (simulator ``is_fraud``), feed the significance test."""
+        alert_t = self.config.stream.alert_score_threshold
+        base = self.config.normalized_weights()
+        for txn, res in zip(txns, results):
+            uid = str(txn.get("user_id", ""))
+            for name in self.ab.active_experiments():
+                variant = self.ab.assign(name, uid)
+                if variant.overrides.get("weights"):
+                    reweighted = apply_weight_overrides(
+                        res["model_predictions"], base,
+                        variant.overrides["weights"])
+                    if reweighted is not None:
+                        res["fraud_probability"] = reweighted
+                        res["fraud_score"] = reweighted
+                        res.setdefault("explanation", {})["experiment"] = {
+                            "name": name, "variant": variant.name}
+                actual = txn.get("is_fraud")
+                self.ab.record_prediction(
+                    name, variant.name, res["fraud_score"],
+                    res["fraud_score"] > alert_t,
+                    bool(actual) if actual is not None else None)
+
+    # ---------------------------------------------------------------- routes
+    def _register_routes(self) -> None:
+        r = self.http.route
+        r("POST", "/predict", self._predict)
+        r("POST", "/batch-predict", self._batch_predict)
+        r("GET", "/health", self._health)
+        r("GET", "/metrics", self._metrics)
+        r("GET", "/model-info", self._model_info)
+        r("POST", "/reload-models", self._reload_models)
+        r("GET", "/metrics/prometheus", self._metrics_prometheus)
+        r("GET", "/drift", self._drift)
+        r("POST", "/experiments", self._create_experiment)
+        r("GET", "/experiments", self._experiment_results)
+
+    async def _predict(self, body, query) -> Tuple[int, Any]:
+        txn, errors = validate_transaction(body)
+        if errors:
+            raise HttpError(422, errors)
+        timeout = self.config.serving.prediction_timeout_seconds
+        try:
+            result = await asyncio.wait_for(
+                self.batcher.submit(txn), timeout=timeout)
+        except asyncio.TimeoutError:
+            self.metrics.record_error("timeout")
+            raise HttpError(408, "prediction timed out")
+        self.metrics.queue_depth.set(self.batcher.queue_depth)
+        return 200, result
+
+    async def _batch_predict(self, body, query) -> Tuple[int, Any]:
+        txns, errors = validate_batch(
+            body, self.config.serving.batch_size_limit)
+        if errors:
+            raise HttpError(422, errors)
+        t0 = time.perf_counter()
+        loop = asyncio.get_running_loop()
+        results = await loop.run_in_executor(
+            None, self._score_batch_sync, txns)
+        return 200, {
+            "results": results,
+            "count": len(results),
+            "processing_time_ms": (time.perf_counter() - t0) * 1e3,
+        }
+
+    async def _health(self, body, query) -> Tuple[int, Any]:
+        info = self.scorer.model_info()
+        loaded = sum(1 for m in info["models"].values() if m["enabled"])
+        return 200, {
+            "status": "healthy",
+            "models_loaded": loaded,
+            "num_models": info["num_models"],
+            "uptime_seconds": time.monotonic() - self._started,
+            "queue_depth": self.batcher.queue_depth,
+        }
+
+    async def _metrics(self, body, query) -> Tuple[int, Any]:
+        return 200, self.metrics.summary()
+
+    async def _metrics_prometheus(self, body, query) -> Tuple[int, Any]:
+        return 200, self.metrics.render_prometheus()
+
+    async def _model_info(self, body, query) -> Tuple[int, Any]:
+        return 200, self.scorer.model_info()
+
+    async def _reload_models(self, body, query) -> Tuple[int, Any]:
+        """Hot swap under a lock (reference main.py:291-305 +
+        model_manager.py:348-380). Body options:
+        {"checkpoint_dir": ..., "step": optional} — restore params (and host
+        state if present) from a checkpoint; {} — fresh re-init (dummy-model
+        analog). The swap happens between batches: the scorer reads
+        ``self.models`` once per score_batch call."""
+        body = body or {}
+        async with self._reload_lock:
+            loop = asyncio.get_running_loop()
+            if "checkpoint_dir" in body:
+                step = body.get("step")
+                if step is not None:
+                    try:
+                        step = int(step)
+                    except (TypeError, ValueError):
+                        raise HttpError(422, f"step must be an integer, "
+                                             f"got {step!r}")
+
+                def _restore():
+                    mgr = CheckpointManager(body["checkpoint_dir"])
+                    import jax
+
+                    template = init_scoring_models(
+                        jax.random.PRNGKey(0),
+                        bert_config=self.scorer.bert_config,
+                        feature_dim=self.scorer.sc.feature_dim,
+                        node_dim=self.scorer.sc.node_dim)
+                    ck = mgr.restore(step=step, params_template=template)
+                    return ck
+                try:
+                    ck = await loop.run_in_executor(None, _restore)
+                except FileNotFoundError as e:
+                    raise HttpError(404, str(e))
+                if ck.params is not None:
+                    self.scorer.set_models(ck.params)
+                if ck.host_state is not None:
+                    restore_scorer_host_state(self.scorer, ck.host_state)
+                source = {"checkpoint": body["checkpoint_dir"],
+                          "step": ck.step}
+            else:
+                import jax
+
+                seed = int(body.get("seed", 0))
+                fresh = await loop.run_in_executor(
+                    None, lambda: init_scoring_models(
+                        jax.random.PRNGKey(seed),
+                        bert_config=self.scorer.bert_config,
+                        feature_dim=self.scorer.sc.feature_dim,
+                        node_dim=self.scorer.sc.node_dim))
+                self.scorer.set_models(fresh)
+                source = {"reinit_seed": seed}
+        return 200, {"status": "reloaded", "source": source}
+
+    async def _drift(self, body, query) -> Tuple[int, Any]:
+        rep = self.drift.report()
+        return 200, {
+            "drifted": rep.drifted,
+            "max_psi": rep.max_psi,
+            "top_features": rep.top_features[:10],
+            "psi": [float(x) for x in rep.psi],
+            "rows_seen": rep.rows_seen,
+            "baseline_frozen": rep.baseline_frozen,
+        }
+
+    async def _create_experiment(self, body, query) -> Tuple[int, Any]:
+        body = body or {}
+        try:
+            name = body["name"]
+            variants = [Variant(v["name"], float(v["traffic"]),
+                                v.get("overrides", {}))
+                        for v in body["variants"]]
+            self.ab.create_experiment(name, variants,
+                                      salt=body.get("salt", ""))
+        except (KeyError, TypeError) as e:
+            raise HttpError(422, f"bad experiment spec: {e}")
+        except ValueError as e:
+            raise HttpError(422, str(e))
+        return 200, {"status": "created", "experiment": name}
+
+    async def _experiment_results(self, body, query) -> Tuple[int, Any]:
+        name = query.get("name")
+        if not name:
+            raise HttpError(422, "query param 'name' required")
+        try:
+            return 200, self.ab.results(name)
+        except KeyError:
+            raise HttpError(404, f"no experiment {name!r}")
+
+    # -------------------------------------------------------------- lifecycle
+    async def start(self) -> None:
+        await self.batcher.start()
+        await self.http.start()
+
+    async def stop(self) -> None:
+        await self.http.stop()
+        await self.batcher.stop()
+
+    @property
+    def port(self) -> int:
+        return self.http.port
+
+    def run_forever(self) -> None:               # pragma: no cover - CLI path
+        async def _main():
+            await self.start()
+            try:
+                await asyncio.Event().wait()
+            finally:
+                await self.stop()
+
+        asyncio.run(_main())

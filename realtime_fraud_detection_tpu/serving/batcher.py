@@ -1,0 +1,126 @@
+"""Request-side deadline microbatcher: concurrent /predict → one device call.
+
+This is the piece the reference conspicuously lacks: its ``/predict`` scores
+batch=1 per request and ``/batch-predict`` is a sequential Python loop
+(main.py:235-248) — "no real batching anywhere in the serving path"
+(SURVEY.md §2.7). Its k8s tree *configures* TF-Serving batching (max_batch
+128, 100 ms timeout, ml-models-deployment.yaml:270-290) that nothing uses.
+
+Here, concurrent requests land in an asyncio queue; a single drain task
+collects up to ``max_batch`` or until ``deadline_ms`` after the first
+request, then runs ONE fused scoring call in a worker thread (the event loop
+never blocks on device work). Every waiter gets its own row's
+FraudPrediction. Deadline defaults to 5 ms — the p99 < 20 ms budget allots
+assemble ≈ 5, transfer+compute ≈ 10, return ≈ 5 (SURVEY.md §7.6).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence
+
+__all__ = ["RequestMicrobatcher"]
+
+
+class RequestMicrobatcher:
+    """Coalesce concurrent scoring requests into deadline-bounded batches."""
+
+    def __init__(
+        self,
+        score_fn: Callable[[Sequence[Mapping[str, Any]]], List[Dict[str, Any]]],
+        max_batch: int = 256,
+        deadline_ms: float = 5.0,
+        max_queue: int = 10_000,
+    ):
+        self.score_fn = score_fn
+        self.max_batch = max_batch
+        self.deadline_s = deadline_ms / 1e3
+        self._queue: asyncio.Queue = asyncio.Queue(maxsize=max_queue)
+        self._task: Optional[asyncio.Task] = None
+        self._closed = False
+        self.batches = 0
+        self.requests = 0
+
+    # ------------------------------------------------------------ lifecycle
+    async def start(self) -> None:
+        if self._task is None:
+            self._closed = False
+            self._task = asyncio.get_running_loop().create_task(self._drain())
+
+    async def stop(self) -> None:
+        self._closed = True
+        if self._task is not None:
+            # a sentinel wakes the drain loop if it's blocked on get()
+            await self._queue.put(None)
+            await self._task
+            self._task = None
+
+    @property
+    def queue_depth(self) -> int:
+        return self._queue.qsize()
+
+    # --------------------------------------------------------------- submit
+    async def submit(self, txn: Mapping[str, Any]) -> Dict[str, Any]:
+        """Enqueue one transaction; resolves to its FraudPrediction dict."""
+        if self._closed:
+            raise RuntimeError("microbatcher is stopped")
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        await self._queue.put((txn, fut))
+        return await fut
+
+    # ---------------------------------------------------------------- drain
+    async def _drain(self) -> None:
+        loop = asyncio.get_running_loop()
+        while True:
+            first = await self._queue.get()
+            if first is None:                    # stop sentinel
+                await self._flush_remaining(loop)
+                return
+            batch = [first]
+            deadline = time.monotonic() + self.deadline_s
+            while len(batch) < self.max_batch:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    item = await asyncio.wait_for(
+                        self._queue.get(), timeout=remaining)
+                except asyncio.TimeoutError:
+                    break
+                if item is None:
+                    await self._score(loop, batch)
+                    await self._flush_remaining(loop)
+                    return
+                batch.append(item)
+            await self._score(loop, batch)
+
+    async def _flush_remaining(self, loop) -> None:
+        """Score whatever raced in behind the stop sentinel — a submit()
+        that passed the _closed check may enqueue after it, and its waiter
+        must not hang forever."""
+        leftovers = []
+        while not self._queue.empty():
+            item = self._queue.get_nowait()
+            if item is not None:
+                leftovers.append(item)
+        for i in range(0, len(leftovers), self.max_batch):
+            await self._score(loop, leftovers[i:i + self.max_batch])
+
+    async def _score(self, loop, batch) -> None:
+        txns = [t for t, _ in batch]
+        futs = [f for _, f in batch]
+        try:
+            # device work off the event loop; one fused program per batch
+            results = await loop.run_in_executor(
+                None, self.score_fn, txns)
+        except Exception as e:                   # noqa: BLE001
+            for f in futs:
+                if not f.done():
+                    f.set_exception(e)
+            return
+        self.batches += 1
+        self.requests += len(batch)
+        for f, r in zip(futs, results):
+            if not f.done():                     # waiter may have timed out
+                f.set_result(r)

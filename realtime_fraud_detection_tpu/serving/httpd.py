@@ -1,0 +1,180 @@
+"""Minimal asyncio HTTP/1.1 server for the scoring API.
+
+Stdlib-only (asyncio streams): FastAPI/uvicorn are not part of this
+framework's dependency surface, and the endpoint set (SURVEY.md §2.7 — seven
+routes, JSON in/out) doesn't need them. Supports keep-alive, content-length
+bodies, JSON errors, and per-connection tasks; TLS/chunked encoding are out
+of scope (the reference terminates TLS at the ALB/ingress, not in-process —
+fraud-detection-additional-resources.yaml ALB listener).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
+from urllib.parse import unquote_plus
+
+__all__ = ["HttpServer", "JsonResponse", "HttpError"]
+
+log = logging.getLogger(__name__)
+
+_MAX_BODY = 32 * 1024 * 1024
+_MAX_HEADER = 64 * 1024
+
+# handler(body_json, query) -> (status, payload)
+Handler = Callable[[Any, Dict[str, str]], Awaitable[Tuple[int, Any]]]
+
+_REASONS = {200: "OK", 202: "Accepted", 400: "Bad Request", 404: "Not Found",
+            405: "Method Not Allowed", 408: "Request Timeout",
+            413: "Payload Too Large", 422: "Unprocessable Entity",
+            500: "Internal Server Error", 503: "Service Unavailable"}
+
+
+class HttpError(Exception):
+    def __init__(self, status: int, detail: Any):
+        super().__init__(detail)
+        self.status = status
+        self.detail = detail
+
+
+class JsonResponse:
+    @staticmethod
+    def encode(status: int, payload: Any, keep_alive: bool,
+               content_type: str = "application/json") -> bytes:
+        if content_type == "application/json":
+            body = json.dumps(payload).encode()
+        else:
+            body = str(payload).encode()
+        reason = _REASONS.get(status, "Unknown")
+        head = (
+            f"HTTP/1.1 {status} {reason}\r\n"
+            f"Content-Type: {content_type}\r\n"
+            f"Content-Length: {len(body)}\r\n"
+            f"Connection: {'keep-alive' if keep_alive else 'close'}\r\n"
+            "\r\n"
+        )
+        return head.encode() + body
+
+
+class HttpServer:
+    """Route table + asyncio server. Routes are (METHOD, path) exact-match."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host = host
+        self.port = port
+        self._routes: Dict[Tuple[str, str], Handler] = {}
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    def route(self, method: str, path: str, handler: Handler) -> None:
+        self._routes[(method.upper(), path)] = handler
+
+    async def start(self) -> None:
+        # limit > _MAX_HEADER so readuntil can see an oversized header block
+        # and we answer 413 instead of tripping the reader's own limit
+        self._server = await asyncio.start_server(
+            self._handle_conn, self.host, self.port, limit=2 * _MAX_HEADER)
+        # resolve the ephemeral port
+        self.port = self._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    # ------------------------------------------------------------- protocol
+    async def _handle_conn(self, reader: asyncio.StreamReader,
+                           writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                keep_alive = await self._handle_one(reader, writer)
+                if not keep_alive:
+                    break
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        except Exception:                        # noqa: BLE001
+            log.exception("connection handler error")
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:                    # noqa: BLE001
+                pass
+
+    async def _handle_one(self, reader, writer) -> bool:
+        try:
+            header_blob = await reader.readuntil(b"\r\n\r\n")
+        except asyncio.LimitOverrunError:
+            await self._respond(writer, 413, {"detail": "headers too large"},
+                                False)
+            return False
+        if len(header_blob) > _MAX_HEADER:
+            await self._respond(writer, 413, {"detail": "headers too large"},
+                                False)
+            return False
+        head_lines = header_blob.decode("latin-1").split("\r\n")
+        try:
+            method, target, _version = head_lines[0].split(" ", 2)
+        except ValueError:
+            await self._respond(writer, 400, {"detail": "bad request line"},
+                                False)
+            return False
+        headers = {}
+        for line in head_lines[1:]:
+            if ":" in line:
+                k, v = line.split(":", 1)
+                headers[k.strip().lower()] = v.strip()
+
+        keep_alive = headers.get("connection", "keep-alive").lower() != "close"
+        length = int(headers.get("content-length", "0") or 0)
+        if length > _MAX_BODY:
+            await self._respond(writer, 413, {"detail": "body too large"},
+                                False)
+            return False
+        raw = await reader.readexactly(length) if length else b""
+
+        path, _, query_str = target.partition("?")
+        query: Dict[str, str] = {}
+        for pair in query_str.split("&"):
+            if "=" in pair:
+                k, _, v = pair.partition("=")
+                query[unquote_plus(k)] = unquote_plus(v)
+
+        handler = self._routes.get((method.upper(), path))
+        if handler is None:
+            known_paths = {p for _, p in self._routes}
+            status = 405 if path in known_paths else 404
+            await self._respond(
+                writer, status, {"detail": f"no route {method} {path}"},
+                keep_alive)
+            return keep_alive
+
+        body: Any = None
+        if raw:
+            try:
+                body = json.loads(raw)
+            except json.JSONDecodeError:
+                await self._respond(
+                    writer, 400, {"detail": "invalid JSON body"}, keep_alive)
+                return keep_alive
+        try:
+            status, payload = await handler(body, query)
+        except HttpError as e:
+            status, payload = e.status, {"detail": e.detail}
+        except Exception:                        # noqa: BLE001
+            log.exception("handler error for %s %s", method, path)
+            status, payload = 500, {"detail": "internal error"}
+        content_type = "application/json"
+        if isinstance(payload, str):
+            content_type = "text/plain; version=0.0.4"  # Prometheus text
+        await self._respond(writer, status, payload, keep_alive, content_type)
+        return keep_alive
+
+    @staticmethod
+    async def _respond(writer, status, payload, keep_alive,
+                       content_type="application/json") -> None:
+        writer.write(JsonResponse.encode(status, payload, keep_alive,
+                                         content_type))
+        await writer.drain()

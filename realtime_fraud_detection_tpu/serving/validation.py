@@ -1,0 +1,70 @@
+"""Request validation for the scoring API.
+
+Mirrors the reference's Pydantic request models (main.py:67-106):
+``TransactionFeatures{transaction_id, user_id, merchant_id, amount,
+currency, payment_method, features{}, timestamp}`` — required identity/amount
+fields, typed optionals, and a free-form ``features`` dict that flows into
+the 64-feature contract. Plain functions instead of Pydantic: validation sits
+on the request hot path and a dict pass costs ~1 µs vs model construction.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Mapping, Tuple
+
+__all__ = ["validate_transaction", "validate_batch"]
+
+_REQUIRED = ("transaction_id", "user_id", "merchant_id", "amount")
+_STRING_FIELDS = ("transaction_id", "user_id", "merchant_id", "currency",
+                  "payment_method", "timestamp")
+
+
+def validate_transaction(body: Any) -> Tuple[Dict[str, Any], List[str]]:
+    """Returns (normalized_txn, errors). Empty errors == valid."""
+    errors: List[str] = []
+    if not isinstance(body, Mapping):
+        return {}, ["body must be a JSON object"]
+    txn: Dict[str, Any] = dict(body)
+    for f in _REQUIRED:
+        if f not in txn or txn[f] in (None, ""):
+            errors.append(f"missing required field: {f}")
+    if "amount" in txn and txn.get("amount") not in (None, ""):
+        try:
+            amount = float(txn["amount"])
+            if not math.isfinite(amount) or amount < 0:
+                errors.append("amount must be a finite non-negative number")
+            else:
+                txn["amount"] = amount
+        except (TypeError, ValueError):
+            errors.append("amount must be a number")
+    for f in _STRING_FIELDS:
+        if f in txn and txn[f] is not None and not isinstance(txn[f], str):
+            txn[f] = str(txn[f])
+    feats = txn.get("features")
+    if feats is not None and not isinstance(feats, Mapping):
+        errors.append("features must be an object of name -> value")
+    return txn, errors
+
+
+def validate_batch(body: Any, limit: int) -> Tuple[List[Dict[str, Any]], List[str]]:
+    """Validate a /batch-predict payload: {"transactions": [...]} or a bare
+    list (the reference accepts a list of TransactionFeatures,
+    main.py:218-233)."""
+    if isinstance(body, Mapping) and "transactions" in body:
+        body = body["transactions"]
+    if not isinstance(body, list):
+        return [], ["body must be a list of transactions or "
+                    "{'transactions': [...]}"]
+    if len(body) == 0:
+        return [], ["empty batch"]
+    if len(body) > limit:
+        return [], [f"batch size {len(body)} exceeds limit {limit}"]
+    txns: List[Dict[str, Any]] = []
+    errors: List[str] = []
+    for i, item in enumerate(body):
+        txn, errs = validate_transaction(item)
+        if errs:
+            errors.extend(f"[{i}] {e}" for e in errs)
+        txns.append(txn)
+    return txns, errors

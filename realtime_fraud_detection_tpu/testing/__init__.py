@@ -5,6 +5,8 @@ from realtime_fraud_detection_tpu.testing.ab import (
     Experiment,
     Variant,
     VariantStats,
+    apply_weight_overrides,
 )
 
-__all__ = ["ABTestManager", "Experiment", "Variant", "VariantStats"]
+__all__ = ["ABTestManager", "Experiment", "Variant", "VariantStats",
+           "apply_weight_overrides"]

@@ -26,7 +26,31 @@ import threading
 import time
 from typing import Any, Dict, List, Mapping, Optional
 
-__all__ = ["Variant", "VariantStats", "Experiment", "ABTestManager"]
+__all__ = ["Variant", "VariantStats", "Experiment", "ABTestManager",
+           "apply_weight_overrides"]
+
+
+def apply_weight_overrides(
+        model_predictions: Mapping[str, float],
+        base_weights: Mapping[str, float],
+        overrides: Mapping[str, float]) -> Optional[float]:
+    """Re-combine per-model predictions under variant weight overrides.
+
+    The fused scorer returns every branch's prediction, so a variant that
+    only changes ensemble weights can be evaluated host-side as the same
+    weighted average the device combine computes (ensemble_predictor.py:
+    263-284 semantics) — zero extra device work per arm. Returns None when
+    no overridden model actually produced a prediction."""
+    weights = {k: float(v) for k, v in base_weights.items()}
+    weights.update({k: float(v) for k, v in overrides.items()})
+    num = den = 0.0
+    for name, pred in model_predictions.items():
+        w = weights.get(name, 0.0)
+        num += w * float(pred)
+        den += w
+    if den <= 0.0:
+        return None
+    return num / den
 
 
 @dataclasses.dataclass
@@ -129,6 +153,10 @@ class ABTestManager:
     def stop_experiment(self, name: str) -> None:
         with self._lock:
             self._experiments[name].active = False
+
+    def active_experiments(self) -> List[str]:
+        with self._lock:
+            return [n for n, e in self._experiments.items() if e.active]
 
     # -------------------------------------------------------------- routing
     def assign(self, experiment: str, user_id: str) -> Variant:

@@ -2,7 +2,11 @@
 
 import pytest
 
-from realtime_fraud_detection_tpu.testing import ABTestManager, Variant
+from realtime_fraud_detection_tpu.testing import (
+    ABTestManager,
+    Variant,
+    apply_weight_overrides,
+)
 
 
 def two_arm(mgr, name="exp", split=0.5, salt=""):
@@ -90,6 +94,28 @@ class TestEvaluation:
         mgr.record_prediction("exp", "control", 0.4, False)
         sig = mgr.results("exp")["significance"]
         assert not sig["computed"]
+
+    def test_apply_weight_overrides_reweights(self):
+        preds = {"a": 1.0, "b": 0.0}
+        base = {"a": 0.5, "b": 0.5}
+        assert apply_weight_overrides(preds, base, {}) == pytest.approx(0.5)
+        # tilt fully onto model a
+        assert apply_weight_overrides(
+            preds, base, {"b": 0.0}) == pytest.approx(1.0)
+        assert apply_weight_overrides(
+            preds, base, {"a": 0.25, "b": 0.75}) == pytest.approx(0.25)
+
+    def test_apply_weight_overrides_no_live_models(self):
+        assert apply_weight_overrides({}, {"a": 1.0}, {}) is None
+        assert apply_weight_overrides(
+            {"a": 0.8}, {"a": 0.0}, {}) is None
+
+    def test_active_experiments_listing(self):
+        mgr = ABTestManager()
+        two_arm(mgr, name="e1")
+        two_arm(mgr, name="e2")
+        mgr.stop_experiment("e1")
+        assert mgr.active_experiments() == ["e2"]
 
     def test_overrides_flow_through_routing(self):
         mgr = ABTestManager()

@@ -1,0 +1,373 @@
+"""Serving layer: live HTTP server, microbatching, all §2.7 endpoints."""
+
+import http.client
+import json
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import pytest
+
+from realtime_fraud_detection_tpu.serving import (
+    RequestMicrobatcher,
+    validate_batch,
+    validate_transaction,
+)
+from realtime_fraud_detection_tpu.sim.simulator import TransactionGenerator
+
+
+# ---------------------------------------------------------------------------
+# validation (pure)
+# ---------------------------------------------------------------------------
+
+class TestValidation:
+    def test_valid_transaction_normalizes(self):
+        txn, errs = validate_transaction({
+            "transaction_id": "t1", "user_id": 7, "merchant_id": "m1",
+            "amount": "12.5",
+        })
+        assert errs == []
+        assert txn["amount"] == 12.5
+        assert txn["user_id"] == "7"
+
+    def test_missing_required(self):
+        _, errs = validate_transaction({"transaction_id": "t1"})
+        assert any("user_id" in e for e in errs)
+        assert any("amount" in e for e in errs)
+
+    def test_bad_amount(self):
+        _, errs = validate_transaction(
+            {"transaction_id": "t", "user_id": "u", "merchant_id": "m",
+             "amount": "NaN"})
+        assert any("amount" in e for e in errs)
+
+    def test_batch_forms_and_limit(self):
+        good = {"transaction_id": "t", "user_id": "u", "merchant_id": "m",
+                "amount": 1.0}
+        txns, errs = validate_batch({"transactions": [good]}, limit=10)
+        assert errs == [] and len(txns) == 1
+        _, errs = validate_batch([good] * 11, limit=10)
+        assert any("exceeds limit" in e for e in errs)
+        _, errs = validate_batch({"nope": 1}, limit=10)
+        assert errs
+
+
+# ---------------------------------------------------------------------------
+# microbatcher (asyncio, no device)
+# ---------------------------------------------------------------------------
+
+class TestRequestMicrobatcher:
+    def test_coalesces_concurrent_requests(self):
+        import asyncio
+
+        seen_sizes = []
+
+        def fake_score(txns):
+            seen_sizes.append(len(txns))
+            return [{"transaction_id": t["transaction_id"], "i": i}
+                    for i, t in enumerate(txns)]
+
+        async def main():
+            b = RequestMicrobatcher(fake_score, max_batch=64, deadline_ms=20)
+            await b.start()
+            results = await asyncio.gather(
+                *[b.submit({"transaction_id": f"t{i}"}) for i in range(16)])
+            await b.stop()
+            return results
+
+        results = asyncio.run(main())
+        assert len(results) == 16
+        # all 16 submitted together -> far fewer device calls than requests
+        assert len(seen_sizes) <= 4
+        assert sum(seen_sizes) == 16
+        # each waiter got ITS OWN row back
+        assert all(r["transaction_id"] == f"t{i}"
+                   for i, r in enumerate(results))
+
+    def test_score_failure_propagates(self):
+        import asyncio
+
+        def boom(txns):
+            raise RuntimeError("device fell over")
+
+        async def main():
+            b = RequestMicrobatcher(boom, max_batch=4, deadline_ms=1)
+            await b.start()
+            with pytest.raises(RuntimeError, match="device fell over"):
+                await b.submit({"transaction_id": "t"})
+            await b.stop()
+
+        asyncio.run(main())
+
+    def test_submit_racing_stop_does_not_hang(self):
+        import asyncio
+
+        def fake_score(txns):
+            return [dict(t) for t in txns]
+
+        async def main():
+            b = RequestMicrobatcher(fake_score, max_batch=4, deadline_ms=5)
+            await b.start()
+            # enqueue a submit concurrently with stop: the waiter must
+            # resolve either way (flush-behind-sentinel path)
+            sub = asyncio.get_running_loop().create_task(b.submit({"i": 1}))
+            await asyncio.sleep(0)               # let submit pass _closed
+            stop = asyncio.get_running_loop().create_task(b.stop())
+            result = await asyncio.wait_for(sub, timeout=5)
+            await stop
+            return result
+
+        assert asyncio.run(main()) == {"i": 1}
+
+    def test_max_batch_respected(self):
+        import asyncio
+
+        sizes = []
+
+        def fake_score(txns):
+            sizes.append(len(txns))
+            return [dict(t) for t in txns]
+
+        async def main():
+            b = RequestMicrobatcher(fake_score, max_batch=8, deadline_ms=50)
+            await b.start()
+            await asyncio.gather(*[b.submit({"i": i}) for i in range(20)])
+            await b.stop()
+
+        asyncio.run(main())
+        assert max(sizes) <= 8
+        assert sum(sizes) == 20
+
+
+# ---------------------------------------------------------------------------
+# live server (session-scoped: one compile)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def app_server():
+    import asyncio
+
+    from realtime_fraud_detection_tpu.serving import ServingApp
+    from realtime_fraud_detection_tpu.utils.config import Config
+
+    config = Config()
+    config.serving.microbatch_deadline_ms = 10.0
+    # each new batch bucket compiles once (~tens of seconds on the CPU test
+    # backend); the timeout must cover compilation, not just steady state
+    config.serving.prediction_timeout_seconds = 180.0
+    app = ServingApp(config, host="127.0.0.1", port=0)
+    gen = TransactionGenerator(num_users=128, num_merchants=32)
+    app.scorer.seed_profiles(gen.users.profiles(), gen.merchants.profiles())
+
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+
+    def run():
+        asyncio.set_event_loop(loop)
+
+        async def _start():
+            await app.start()
+            started.set()
+
+        loop.run_until_complete(_start())
+        loop.run_forever()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert started.wait(timeout=30)
+    yield app, gen
+    asyncio.run_coroutine_threadsafe(app.stop(), loop).result(timeout=10)
+    loop.call_soon_threadsafe(loop.stop)
+    t.join(timeout=5)
+
+
+def _request(port, method, path, body=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+    payload = json.dumps(body) if body is not None else None
+    conn.request(method, path, body=payload,
+                 headers={"Content-Type": "application/json"} if payload else {})
+    resp = conn.getresponse()
+    raw = resp.read()
+    conn.close()
+    ctype = resp.getheader("Content-Type", "")
+    data = json.loads(raw) if "json" in ctype else raw.decode()
+    return resp.status, data
+
+
+def _txn(gen):
+    return gen.generate_batch(1)[0]
+
+
+class TestEndpoints:
+    def test_predict_returns_fraud_prediction_schema(self, app_server):
+        app, gen = app_server
+        status, data = _request(app.port, "POST", "/predict", _txn(gen))
+        assert status == 200
+        for field in ("transaction_id", "fraud_probability", "fraud_score",
+                      "risk_level", "decision", "model_predictions",
+                      "confidence", "processing_time_ms", "explanation"):
+            assert field in data, field
+        assert 0.0 <= data["fraud_probability"] <= 1.0
+        assert data["decision"] in ("APPROVE", "APPROVE_WITH_MONITORING",
+                                    "REVIEW", "DECLINE")
+        assert set(data["model_predictions"]) == {
+            "xgboost_primary", "lstm_sequential", "bert_text",
+            "graph_neural", "isolation_forest"}
+
+    def test_predict_validation_422(self, app_server):
+        app, _ = app_server
+        status, data = _request(app.port, "POST", "/predict",
+                                {"transaction_id": "x"})
+        assert status == 422
+        assert any("user_id" in e for e in data["detail"])
+
+    def test_concurrent_predicts_microbatch(self, app_server):
+        app, gen = app_server
+        txns = gen.generate_batch(32)
+        batches_before = app.batcher.batches
+
+        with ThreadPoolExecutor(max_workers=32) as ex:
+            out = list(ex.map(
+                lambda t: _request(app.port, "POST", "/predict", t), txns))
+        assert all(s == 200 for s, _ in out)
+        ids = {d["transaction_id"] for _, d in out}
+        assert len(ids) == 32                    # every caller got its own row
+        batches_done = app.batcher.batches - batches_before
+        assert batches_done < 32                 # real coalescing happened
+
+    def test_batch_predict(self, app_server):
+        app, gen = app_server
+        txns = gen.generate_batch(8)
+        status, data = _request(app.port, "POST", "/batch-predict",
+                                {"transactions": txns})
+        assert status == 200
+        assert data["count"] == 8
+        assert len(data["results"]) == 8
+
+    def test_health(self, app_server):
+        app, _ = app_server
+        status, data = _request(app.port, "GET", "/health")
+        assert status == 200
+        assert data["status"] == "healthy"
+        assert data["models_loaded"] == 5
+
+    def test_metrics_json_and_prometheus(self, app_server):
+        app, gen = app_server
+        _request(app.port, "POST", "/predict", _txn(gen))
+        status, data = _request(app.port, "GET", "/metrics")
+        assert status == 200 and data["total_predictions"] >= 1
+        status, text = _request(app.port, "GET", "/metrics/prometheus")
+        assert status == 200
+        assert "ml_predictions_total" in text
+        assert "scoring_microbatch_size_bucket" in text
+
+    def test_model_info(self, app_server):
+        app, _ = app_server
+        status, data = _request(app.port, "GET", "/model-info")
+        assert status == 200
+        assert data["num_models"] == 5
+        weights = [m["weight"] for m in data["models"].values()]
+        assert abs(sum(weights) - 1.0) < 1e-6
+
+    def test_reload_models_reinit(self, app_server):
+        app, gen = app_server
+        status, data = _request(app.port, "POST", "/reload-models",
+                                {"seed": 123})
+        assert status == 200 and data["status"] == "reloaded"
+        # service still scores after the swap
+        status, data = _request(app.port, "POST", "/predict", _txn(gen))
+        assert status == 200
+
+    def test_reload_from_checkpoint(self, app_server, tmp_path):
+        import jax
+
+        from realtime_fraud_detection_tpu.checkpoint import CheckpointManager
+        from realtime_fraud_detection_tpu.scoring import init_scoring_models
+
+        app, gen = app_server
+        models = init_scoring_models(jax.random.PRNGKey(99))
+        CheckpointManager(tmp_path).save(3, params=models)
+        status, data = _request(app.port, "POST", "/reload-models",
+                                {"checkpoint_dir": str(tmp_path)})
+        assert status == 200
+        assert data["source"]["step"] == 3
+        status, _ = _request(app.port, "POST", "/predict", _txn(gen))
+        assert status == 200
+
+    def test_reload_missing_checkpoint_404(self, app_server, tmp_path):
+        app, _ = app_server
+        status, _ = _request(app.port, "POST", "/reload-models",
+                             {"checkpoint_dir": str(tmp_path / "nope")})
+        assert status == 404
+
+    def test_drift_endpoint(self, app_server):
+        app, _ = app_server
+        status, data = _request(app.port, "GET", "/drift")
+        assert status == 200
+        assert "drifted" in data and "rows_seen" in data
+
+    def test_experiments_create_and_results(self, app_server):
+        app, gen = app_server
+        spec = {"name": "exp-http", "variants": [
+            {"name": "control", "traffic": 0.5},
+            {"name": "treatment", "traffic": 0.5,
+             "overrides": {"weights": {"bert_text": 0.9}}},
+        ]}
+        status, data = _request(app.port, "POST", "/experiments", spec)
+        assert status == 200
+        # experiments are WIRED: traffic through /predict accumulates arm data
+        for txn in gen.generate_batch(16):
+            s, _ = _request(app.port, "POST", "/predict", txn)
+            assert s == 200
+        status, data = _request(app.port, "GET", "/experiments?name=exp-http")
+        assert status == 200
+        assert set(data["variants"]) == {"control", "treatment"}
+        total_preds = sum(v["predictions"] for v in data["variants"].values())
+        assert total_preds >= 16
+        status, _ = _request(app.port, "GET", "/experiments?name=ghost")
+        assert status == 404
+        app.ab.stop_experiment("exp-http")       # don't leak into other tests
+
+    def test_query_params_percent_decoded(self, app_server):
+        app, _ = app_server
+        spec = {"name": "my exp", "variants": [{"name": "only",
+                                                "traffic": 1.0}]}
+        status, _ = _request(app.port, "POST", "/experiments", spec)
+        assert status == 200
+        status, data = _request(app.port, "GET", "/experiments?name=my%20exp")
+        assert status == 200
+        assert data["experiment"] == "my exp"
+        app.ab.stop_experiment("my exp")
+
+    def test_reload_non_integer_step_422(self, app_server, tmp_path):
+        app, _ = app_server
+        status, _ = _request(app.port, "POST", "/reload-models",
+                             {"checkpoint_dir": str(tmp_path),
+                              "step": "three"})
+        assert status == 422
+
+    def test_oversized_headers_413(self, app_server):
+        app, _ = app_server
+        conn = http.client.HTTPConnection("127.0.0.1", app.port, timeout=30)
+        conn.request("GET", "/health", headers={"X-Big": "a" * 70_000})
+        resp = conn.getresponse()
+        assert resp.status == 413
+        resp.read()
+        conn.close()
+
+    def test_unknown_route_404_and_405(self, app_server):
+        app, _ = app_server
+        status, _ = _request(app.port, "GET", "/nope")
+        assert status == 404
+        status, _ = _request(app.port, "GET", "/predict")
+        assert status == 405
+
+    def test_bad_json_400(self, app_server):
+        app, _ = app_server
+        conn = http.client.HTTPConnection("127.0.0.1", app.port, timeout=30)
+        conn.request("POST", "/predict", body="{not json",
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 400
+        resp.read()
+        conn.close()
