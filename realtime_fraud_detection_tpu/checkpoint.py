@@ -67,8 +67,10 @@ class CheckpointManager:
     """Save/restore/retain checkpoints under one directory."""
 
     def __init__(self, directory: str | Path, keep: int = 3):
+        # directory creation is deferred to save(): a restore-only caller
+        # (e.g. /reload-models with a user-supplied path) must not mutate
+        # the filesystem at an arbitrary location
         self.directory = Path(directory)
-        self.directory.mkdir(parents=True, exist_ok=True)
         self.keep = keep
 
     # ------------------------------------------------------------- plumbing

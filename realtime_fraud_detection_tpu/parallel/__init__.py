@@ -5,6 +5,9 @@ communication fabric (SURVEY.md §2.8/§5.8), expressed as named-axis
 shardings that XLA lowers to collectives.
 """
 
+from realtime_fraud_detection_tpu.parallel.context import (  # noqa: F401
+    ring_attention,
+)
 from realtime_fraud_detection_tpu.parallel.layouts import (  # noqa: F401
     batch_shardings,
     bert_param_specs,

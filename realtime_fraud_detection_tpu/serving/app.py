@@ -123,10 +123,13 @@ class ServingApp:
                 if variant.overrides.get("weights"):
                     reweighted = apply_weight_overrides(
                         res["model_predictions"], base,
-                        variant.overrides["weights"])
+                        variant.overrides["weights"],
+                        self.config.ensemble.confidence_threshold)
                     if reweighted is not None:
-                        res["fraud_probability"] = reweighted
-                        res["fraud_score"] = reweighted
+                        # decision + risk_level are recomputed with the new
+                        # score so the served record stays consistent
+                        res.update(reweighted)
+                        res["fraud_score"] = reweighted["fraud_probability"]
                         res.setdefault("explanation", {})["experiment"] = {
                             "name": name, "variant": variant.name}
                 actual = txn.get("is_fraud")
@@ -232,23 +235,33 @@ class ServingApp:
                     ck = await loop.run_in_executor(None, _restore)
                 except FileNotFoundError as e:
                     raise HttpError(404, str(e))
-                if ck.params is not None:
-                    self.scorer.set_models(ck.params)
-                if ck.host_state is not None:
-                    restore_scorer_host_state(self.scorer, ck.host_state)
+
+                def _swap():
+                    # _score_lock keeps the swap atomic w.r.t. an in-flight
+                    # score_batch in the batcher/executor threads (graph and
+                    # entity-index state must change together)
+                    with self._score_lock:
+                        if ck.params is not None:
+                            self.scorer.set_models(ck.params)
+                        if ck.host_state is not None:
+                            restore_scorer_host_state(self.scorer, ck.host_state)
+                await loop.run_in_executor(None, _swap)
                 source = {"checkpoint": body["checkpoint_dir"],
                           "step": ck.step}
             else:
                 import jax
 
                 seed = int(body.get("seed", 0))
-                fresh = await loop.run_in_executor(
-                    None, lambda: init_scoring_models(
+
+                def _reinit():
+                    fresh = init_scoring_models(
                         jax.random.PRNGKey(seed),
                         bert_config=self.scorer.bert_config,
                         feature_dim=self.scorer.sc.feature_dim,
-                        node_dim=self.scorer.sc.node_dim))
-                self.scorer.set_models(fresh)
+                        node_dim=self.scorer.sc.node_dim)
+                    with self._score_lock:
+                        self.scorer.set_models(fresh)
+                await loop.run_in_executor(None, _reinit)
                 source = {"reinit_seed": seed}
         return 200, {"status": "reloaded", "source": source}
 

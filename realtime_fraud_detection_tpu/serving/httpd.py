@@ -29,7 +29,8 @@ Handler = Callable[[Any, Dict[str, str]], Awaitable[Tuple[int, Any]]]
 _REASONS = {200: "OK", 202: "Accepted", 400: "Bad Request", 404: "Not Found",
             405: "Method Not Allowed", 408: "Request Timeout",
             413: "Payload Too Large", 422: "Unprocessable Entity",
-            500: "Internal Server Error", 503: "Service Unavailable"}
+            500: "Internal Server Error", 501: "Not Implemented",
+            503: "Service Unavailable"}
 
 
 class HttpError(Exception):
@@ -66,6 +67,7 @@ class HttpServer:
         self.port = port
         self._routes: Dict[Tuple[str, str], Handler] = {}
         self._server: Optional[asyncio.AbstractServer] = None
+        self._conns: set = set()
 
     def route(self, method: str, path: str, handler: Handler) -> None:
         self._routes[(method.upper(), path)] = handler
@@ -81,22 +83,33 @@ class HttpServer:
     async def stop(self) -> None:
         if self._server is not None:
             self._server.close()
+            # cancel idle keep-alive handlers: on py3.12 wait_closed() waits
+            # for every connection handler, so a client parked between
+            # requests would otherwise hang shutdown forever
+            for task in list(self._conns):
+                task.cancel()
             await self._server.wait_closed()
             self._server = None
 
     # ------------------------------------------------------------- protocol
     async def _handle_conn(self, reader: asyncio.StreamReader,
                            writer: asyncio.StreamWriter) -> None:
+        task = asyncio.current_task()
+        if task is not None:
+            self._conns.add(task)
         try:
             while True:
                 keep_alive = await self._handle_one(reader, writer)
                 if not keep_alive:
                     break
-        except (asyncio.IncompleteReadError, ConnectionError):
+        except (asyncio.IncompleteReadError, ConnectionError,
+                asyncio.CancelledError):
             pass
         except Exception:                        # noqa: BLE001
             log.exception("connection handler error")
         finally:
+            if task is not None:
+                self._conns.discard(task)
             try:
                 writer.close()
                 await writer.wait_closed()
@@ -128,10 +141,23 @@ class HttpServer:
                 headers[k.strip().lower()] = v.strip()
 
         keep_alive = headers.get("connection", "keep-alive").lower() != "close"
-        length = int(headers.get("content-length", "0") or 0)
-        if length > _MAX_BODY:
-            await self._respond(writer, 413, {"detail": "body too large"},
-                                False)
+        if "transfer-encoding" in headers:
+            # chunked bodies are out of scope; reject rather than misparse
+            # the chunk stream as the next request on this connection
+            await self._respond(
+                writer, 501, {"detail": "transfer-encoding not supported"},
+                False)
+            return False
+        try:
+            length = int(headers.get("content-length", "0") or 0)
+        except ValueError:
+            await self._respond(writer, 400,
+                                {"detail": "bad content-length"}, False)
+            return False
+        if length < 0 or length > _MAX_BODY:
+            status, msg = ((413, "body too large") if length > 0
+                           else (400, "bad content-length"))
+            await self._respond(writer, status, {"detail": msg}, False)
             return False
         raw = await reader.readexactly(length) if length else b""
 

@@ -33,24 +33,51 @@ __all__ = ["Variant", "VariantStats", "Experiment", "ABTestManager",
 def apply_weight_overrides(
         model_predictions: Mapping[str, float],
         base_weights: Mapping[str, float],
-        overrides: Mapping[str, float]) -> Optional[float]:
+        overrides: Mapping[str, float],
+        confidence_threshold: float = 0.7) -> Optional[Dict[str, Any]]:
     """Re-combine per-model predictions under variant weight overrides.
 
     The fused scorer returns every branch's prediction, so a variant that
     only changes ensemble weights can be evaluated host-side as the same
     weighted average the device combine computes (ensemble_predictor.py:
-    263-284 semantics) — zero extra device work per arm. Returns None when
-    no overridden model actually produced a prediction."""
+    263-284 semantics) — zero extra device work per arm. The full downstream
+    outcome is recomputed so the served record stays internally consistent:
+    confidence (:325-342), decision ladder (:344-356), risk level (:358-369).
+    Returns None when no overridden model actually produced a prediction."""
+    from realtime_fraud_detection_tpu.utils.config import (
+        DEFAULT_CONFIDENCE_MULTIPLIER,
+        MODEL_CONFIDENCE_MULTIPLIER,
+    )
+
     weights = {k: float(v) for k, v in base_weights.items()}
     weights.update({k: float(v) for k, v in overrides.items()})
-    num = den = 0.0
+    num = den = conf_num = 0.0
     for name, pred in model_predictions.items():
         w = weights.get(name, 0.0)
-        num += w * float(pred)
+        p = float(pred)
+        mult = MODEL_CONFIDENCE_MULTIPLIER.get(name, DEFAULT_CONFIDENCE_MULTIPLIER)
+        num += w * p
+        conf_num += w * min(1.0, abs(p - 0.5) * 2.0 * mult)
         den += w
     if den <= 0.0:
         return None
-    return num / den
+    prob = num / den
+    confidence = conf_num / den
+    if confidence < confidence_threshold:
+        decision = "REVIEW"
+    elif prob >= 0.95:
+        decision = "DECLINE"
+    elif prob >= 0.8:
+        decision = "REVIEW"
+    elif prob >= 0.6:
+        decision = "APPROVE_WITH_MONITORING"
+    else:
+        decision = "APPROVE"
+    risk = ("CRITICAL" if prob >= 0.95 else "HIGH" if prob >= 0.8
+            else "MEDIUM" if prob >= 0.6 else "LOW" if prob >= 0.3
+            else "VERY_LOW")
+    return {"fraud_probability": prob, "confidence": confidence,
+            "decision": decision, "risk_level": risk}
 
 
 @dataclasses.dataclass

@@ -98,12 +98,32 @@ class TestEvaluation:
     def test_apply_weight_overrides_reweights(self):
         preds = {"a": 1.0, "b": 0.0}
         base = {"a": 0.5, "b": 0.5}
-        assert apply_weight_overrides(preds, base, {}) == pytest.approx(0.5)
+        out = apply_weight_overrides(preds, base, {})
+        assert out["fraud_probability"] == pytest.approx(0.5)
         # tilt fully onto model a
-        assert apply_weight_overrides(
-            preds, base, {"b": 0.0}) == pytest.approx(1.0)
-        assert apply_weight_overrides(
-            preds, base, {"a": 0.25, "b": 0.75}) == pytest.approx(0.25)
+        out = apply_weight_overrides(preds, base, {"b": 0.0})
+        assert out["fraud_probability"] == pytest.approx(1.0)
+        out = apply_weight_overrides(preds, base, {"a": 0.25, "b": 0.75})
+        assert out["fraud_probability"] == pytest.approx(0.25)
+
+    def test_apply_weight_overrides_outcome_consistency(self):
+        """decision/risk_level must match the reweighted probability
+        (ensemble_predictor.py:344-369 ladders)."""
+        # unknown model names get the default multiplier 0.5 -> confidence
+        # = |p-0.5|*2*0.5; p=1.0 on one model gives confidence 0.5 < 0.7
+        out = apply_weight_overrides({"a": 1.0}, {"a": 1.0}, {})
+        assert out["decision"] == "REVIEW"          # low confidence
+        assert out["risk_level"] == "CRITICAL"
+        # xgboost_primary's multiplier is 1.0 -> confidence 1.0 at p=1.0
+        out = apply_weight_overrides(
+            {"xgboost_primary": 1.0}, {"xgboost_primary": 1.0}, {})
+        assert out["decision"] == "DECLINE"
+        assert out["risk_level"] == "CRITICAL"
+        out = apply_weight_overrides(
+            {"xgboost_primary": 0.05}, {"xgboost_primary": 1.0}, {})
+        assert out["decision"] == "APPROVE"
+        assert out["risk_level"] == "VERY_LOW"
+        assert out["confidence"] == pytest.approx(0.9)
 
     def test_apply_weight_overrides_no_live_models(self):
         assert apply_weight_overrides({}, {"a": 1.0}, {}) is None
