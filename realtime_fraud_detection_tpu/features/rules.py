@@ -29,6 +29,35 @@ RISK_LEVEL_NAMES: tuple[str, ...] = (
 VERY_LOW, LOW, MEDIUM, HIGH, CRITICAL = range(5)
 
 
+def ensemble_decision_name(prob: float, confidence: float,
+                           confidence_threshold: float = 0.7) -> str:
+    """Host-side scalar twin of ``ensemble.combine.ensemble_decision``
+    (ensemble_predictor.py:344-356). One source of truth for the thresholds
+    shared by the device ladder and host-side consumers (A/B reweighting)."""
+    if confidence < confidence_threshold:
+        return DECISIONS[REVIEW]
+    if prob >= 0.95:
+        return DECISIONS[DECLINE]
+    if prob >= 0.8:
+        return DECISIONS[REVIEW]
+    if prob >= 0.6:
+        return DECISIONS[APPROVE_WITH_MONITORING]
+    return DECISIONS[APPROVE]
+
+
+def risk_level_name(prob: float) -> str:
+    """Host-side scalar twin of ``risk_level_code``
+    (ensemble_predictor.py:358-369)."""
+    code = (prob >= 0.3) + (prob >= 0.6) + (prob >= 0.8) + (prob >= 0.95)
+    return RISK_LEVEL_NAMES[int(code)]
+
+
+def model_confidence_value(prob: float, multiplier: float) -> float:
+    """Host-side scalar twin of ``ensemble.combine.model_confidence``
+    (ensemble_predictor.py:325-342)."""
+    return min(1.0, abs(prob - 0.5) * 2.0 * multiplier)
+
+
 @jax.jit
 def rule_score(b: TransactionBatch) -> jax.Array:
     """Rule-based fraud score in [0, 1] (TransactionProcessor.java:327-439)."""

@@ -67,7 +67,9 @@ class HttpServer:
         self.port = port
         self._routes: Dict[Tuple[str, str], Handler] = {}
         self._server: Optional[asyncio.AbstractServer] = None
-        self._conns: set = set()
+        # task -> True while parked waiting for the next request (idle)
+        self._conns: Dict[Any, bool] = {}
+        self._closing = False
 
     def route(self, method: str, path: str, handler: Handler) -> None:
         self._routes[(method.upper(), path)] = handler
@@ -83,25 +85,32 @@ class HttpServer:
     async def stop(self) -> None:
         if self._server is not None:
             self._server.close()
-            # cancel idle keep-alive handlers: on py3.12 wait_closed() waits
-            # for every connection handler, so a client parked between
-            # requests would otherwise hang shutdown forever
-            for task in list(self._conns):
-                task.cancel()
+            # Cancel only IDLE keep-alive handlers (parked waiting for the
+            # next request): on py3.12 wait_closed() waits for every
+            # connection handler, so a parked client would otherwise hang
+            # shutdown forever. Handlers mid-request finish their response
+            # first and then exit via the _closing flag.
+            self._closing = True
+            for task, idle in list(self._conns.items()):
+                if idle:
+                    task.cancel()
             await self._server.wait_closed()
             self._server = None
+            self._closing = False
 
     # ------------------------------------------------------------- protocol
     async def _handle_conn(self, reader: asyncio.StreamReader,
                            writer: asyncio.StreamWriter) -> None:
         task = asyncio.current_task()
         if task is not None:
-            self._conns.add(task)
+            self._conns[task] = True
         try:
             while True:
-                keep_alive = await self._handle_one(reader, writer)
-                if not keep_alive:
+                keep_alive = await self._handle_one(reader, writer, task)
+                if not keep_alive or self._closing:
                     break
+                if task is not None:
+                    self._conns[task] = True     # parked until next request
         except (asyncio.IncompleteReadError, ConnectionError,
                 asyncio.CancelledError):
             pass
@@ -109,20 +118,22 @@ class HttpServer:
             log.exception("connection handler error")
         finally:
             if task is not None:
-                self._conns.discard(task)
+                self._conns.pop(task, None)
             try:
                 writer.close()
                 await writer.wait_closed()
             except Exception:                    # noqa: BLE001
                 pass
 
-    async def _handle_one(self, reader, writer) -> bool:
+    async def _handle_one(self, reader, writer, task=None) -> bool:
         try:
             header_blob = await reader.readuntil(b"\r\n\r\n")
         except asyncio.LimitOverrunError:
             await self._respond(writer, 413, {"detail": "headers too large"},
                                 False)
             return False
+        if task is not None:
+            self._conns[task] = False            # busy: request in flight
         if len(header_blob) > _MAX_HEADER:
             await self._respond(writer, 413, {"detail": "headers too large"},
                                 False)

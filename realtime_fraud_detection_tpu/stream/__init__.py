@@ -21,3 +21,11 @@ from realtime_fraud_detection_tpu.stream.microbatch import (  # noqa: F401
     MicrobatchAssembler,
 )
 from realtime_fraud_detection_tpu.stream.job import JobConfig, StreamJob  # noqa: F401
+from realtime_fraud_detection_tpu.stream.windows import (  # noqa: F401
+    WindowedAnalytics,
+    WindowOperator,
+)
+from realtime_fraud_detection_tpu.stream.joins import (  # noqa: F401
+    MultiStreamCorrelator,
+    WindowJoin,
+)

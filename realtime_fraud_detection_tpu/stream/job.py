@@ -24,6 +24,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 from realtime_fraud_detection_tpu.scoring.scorer import FraudScorer
+from realtime_fraud_detection_tpu.state.stores import _event_time_ms
 from realtime_fraud_detection_tpu.stream import topics as T
 from realtime_fraud_detection_tpu.stream.microbatch import MicrobatchAssembler
 from realtime_fraud_detection_tpu.stream.transport import (
@@ -31,6 +32,7 @@ from realtime_fraud_detection_tpu.stream.transport import (
     InMemoryBroker,
     Record,
 )
+from realtime_fraud_detection_tpu.stream.windows import WindowedAnalytics
 
 
 @dataclasses.dataclass
@@ -43,6 +45,9 @@ class JobConfig:
     alert_threshold: float = 0.7      # FraudDetectionJob.java:66
     emit_features: bool = True
     emit_enriched: bool = True
+    # attach the windowed-analytics stage (the reference built its
+    # WindowProcessor but never wired it into the job graph — SURVEY.md §0.3)
+    enable_analytics: bool = False
 
 
 class StreamJob:
@@ -65,6 +70,9 @@ class StreamJob:
             self.consumer,
             max_batch=self.config.max_batch,
             max_delay_ms=self.config.max_delay_ms,
+        )
+        self.analytics = (
+            WindowedAnalytics(broker) if self.config.enable_analytics else None
         )
         self.counters: Dict[str, int] = {
             "scored": 0, "alerts": 0, "batches": 0, "duplicates_skipped": 0,
@@ -120,14 +128,18 @@ class StreamJob:
             if res["fraud_score"] > cfg.alert_threshold:
                 self.broker.produce(T.ALERTS, self._to_alert(rec.value, res), key=uid)
                 self.counters["alerts"] += 1
-            if cfg.emit_enriched:
+            if cfg.emit_enriched or self.analytics is not None:
                 enriched = dict(rec.value)
                 enriched.update(
                     fraud_score=res["fraud_score"],
                     risk_level=res["risk_level"],
                     decision=res["decision"],
                 )
-                self.broker.produce(T.ENRICHED, enriched, key=uid)
+                if cfg.emit_enriched:
+                    self.broker.produce(T.ENRICHED, enriched, key=uid)
+                if self.analytics is not None:
+                    self.analytics.process(
+                        enriched, _event_time_ms(enriched, now) / 1000.0)
             # features exist only when scoring succeeded (the error fallback
             # never ran assemble, so last_features would be absent/stale)
             if cfg.emit_features and scored_ok:

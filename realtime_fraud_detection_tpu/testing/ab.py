@@ -44,6 +44,11 @@ def apply_weight_overrides(
     outcome is recomputed so the served record stays internally consistent:
     confidence (:325-342), decision ladder (:344-356), risk level (:358-369).
     Returns None when no overridden model actually produced a prediction."""
+    from realtime_fraud_detection_tpu.features.rules import (
+        ensemble_decision_name,
+        model_confidence_value,
+        risk_level_name,
+    )
     from realtime_fraud_detection_tpu.utils.config import (
         DEFAULT_CONFIDENCE_MULTIPLIER,
         MODEL_CONFIDENCE_MULTIPLIER,
@@ -57,27 +62,16 @@ def apply_weight_overrides(
         p = float(pred)
         mult = MODEL_CONFIDENCE_MULTIPLIER.get(name, DEFAULT_CONFIDENCE_MULTIPLIER)
         num += w * p
-        conf_num += w * min(1.0, abs(p - 0.5) * 2.0 * mult)
+        conf_num += w * model_confidence_value(p, mult)
         den += w
     if den <= 0.0:
         return None
     prob = num / den
     confidence = conf_num / den
-    if confidence < confidence_threshold:
-        decision = "REVIEW"
-    elif prob >= 0.95:
-        decision = "DECLINE"
-    elif prob >= 0.8:
-        decision = "REVIEW"
-    elif prob >= 0.6:
-        decision = "APPROVE_WITH_MONITORING"
-    else:
-        decision = "APPROVE"
-    risk = ("CRITICAL" if prob >= 0.95 else "HIGH" if prob >= 0.8
-            else "MEDIUM" if prob >= 0.6 else "LOW" if prob >= 0.3
-            else "VERY_LOW")
     return {"fraud_probability": prob, "confidence": confidence,
-            "decision": decision, "risk_level": risk}
+            "decision": ensemble_decision_name(prob, confidence,
+                                               confidence_threshold),
+            "risk_level": risk_level_name(prob)}
 
 
 @dataclasses.dataclass

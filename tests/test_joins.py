@@ -1,0 +1,151 @@
+"""Stream join tests (stream/joins.py vs StreamJoiner.java semantics)."""
+
+import pytest
+
+from realtime_fraud_detection_tpu.stream.joins import (
+    MultiStreamCorrelator,
+    historical_pattern_key,
+    pattern_similarity,
+    txn_historical_pattern_join,
+    txn_merchant_update_join,
+    txn_user_behavior_join,
+)
+
+
+def txn(user="u1", merchant="m1", amount=50.0, payment="credit_card",
+        category="retail", hour=None, tid="t1"):
+    out = {
+        "transaction_id": tid, "user_id": user, "merchant_id": merchant,
+        "amount": amount, "payment_method": payment,
+        "merchant_category": category,
+    }
+    if hour is not None:
+        out["hour_of_day"] = hour
+    return out
+
+
+class TestUserBehaviorJoin:
+    def test_joins_within_window_with_risk_factors(self):
+        j = txn_user_behavior_join()
+        j.process_left(txn(), 100.0)
+        j.process_right({"user_id": "u1", "anomalous_login": True,
+                         "short_session": False}, 110.0)
+        # advance both watermarks past the 5m window end
+        j.process_left(txn(user="zz", tid="t2"), 700.0)
+        out = j.process_right({"user_id": "zz"}, 700.0)
+        assert len(out) == 1
+        e = out[0]
+        assert e["transaction_id"] == "t1"
+        assert e["risk_factors"] == {"recent_login_anomaly": 0.3}
+        assert e["user_behavior_context"]["anomalous_login"] is True
+
+    def test_no_join_across_windows_or_users(self):
+        j = txn_user_behavior_join()
+        j.process_left(txn(), 100.0)
+        j.process_right({"user_id": "u2"}, 110.0)        # other user
+        j.process_right({"user_id": "u1"}, 400.0)        # next 5m window
+        j.process_left(txn(tid="t9"), 2000.0)
+        out = j.process_right({"user_id": "x"}, 2000.0)
+        assert out == []
+
+    def test_watermark_is_min_of_both_streams(self):
+        j = txn_user_behavior_join()
+        j.process_left(txn(), 100.0)
+        # left side raced ahead; right side still behind -> window must
+        # NOT fire yet
+        out = j.process_left(txn(tid="t2"), 10_000.0)
+        assert out == []
+        assert len(j) == 2
+
+
+class TestMerchantUpdateJoin:
+    def test_blacklist_risk_factor(self):
+        j = txn_merchant_update_join()
+        j.process_left(txn(), 50.0)
+        j.process_right({"merchant_id": "m1", "newly_blacklisted": True,
+                         "risk_level_increased": True}, 60.0)
+        j.process_left(txn(merchant="zz", tid="t2"), 1300.0)
+        out = j.process_right({"merchant_id": "zz"}, 1300.0)
+        (e,) = out
+        assert e["risk_factors"]["merchant_newly_blacklisted"] == 0.8
+        assert e["risk_factors"]["merchant_risk_increase"] == 0.4
+        assert "merchant_fraud_rate_increase" not in e["risk_factors"]
+
+
+class TestHistoricalPatternJoin:
+    def test_pattern_key_buckets_amount_to_100s(self):
+        assert historical_pattern_key("credit_card", "retail", 250.0) == \
+            "credit_card:retail:200"
+        assert historical_pattern_key(None, None, 0.0) == "unknown:unknown:0"
+
+    def test_similarity_formula(self):
+        """StreamJoiner.java:278-301: payment 0.3 + amount 0.4 + time 0.3."""
+        t = txn(amount=100.0, hour=10)
+        p = {"payment_method": "credit_card", "amount_range": 100.0,
+             "hour_of_day": 10}
+        assert pattern_similarity(t, p) == pytest.approx(1.0)
+        p2 = {"payment_method": "crypto", "amount_range": 200.0,
+              "hour_of_day": 22}
+        expected = 0.0 + (1 - 100 / 200) * 0.4 + (1 - 12 / 12) * 0.3
+        assert pattern_similarity(t, p2) == pytest.approx(expected)
+
+    def test_join_emits_similarity_scaled_risk(self):
+        j = txn_historical_pattern_join()
+        j.process_left(txn(amount=250.0, hour=3), 100.0)
+        j.process_right(
+            {"payment_method": "credit_card", "merchant_category": "retail",
+             "amount_range": 280.0, "hour_of_day": 3, "fraud_rate": 0.8,
+             "recent_pattern": True, "occurrence_count": 500}, 200.0)
+        j.process_left(txn(payment="zz", tid="t2"), 8000.0)
+        out = j.process_right({"payment_method": "zz", "amount_range": 0.0},
+                              8000.0)
+        (e,) = out
+        rf = e["risk_factors"]
+        sim = pattern_similarity(
+            txn(amount=250.0, hour=3), e["historical_pattern_context"])
+        assert rf["historical_pattern_similarity"] == pytest.approx(sim * 0.8)
+        assert rf["recent_high_fraud_pattern"] == 0.4   # recent & rate>0.5
+        assert rf["frequent_fraud_pattern"] == 0.3      # >100 occ & rate>0.3
+
+    def test_flush_joins_open_windows(self):
+        j = txn_historical_pattern_join()
+        j.process_left(txn(amount=100.0), 10.0)
+        j.process_right({"payment_method": "credit_card",
+                         "merchant_category": "retail",
+                         "amount_range": 110.0, "fraud_rate": 0.1}, 20.0)
+        assert j.flush()
+        assert len(j) == 0
+
+
+class TestCorrelator:
+    def test_emits_on_coinciding_signals(self):
+        c = MultiStreamCorrelator(min_signals=2)
+        c.on_behavior({"user_id": "u1", "anomalous_login": True}, 100.0)
+        c.on_device({"user_id": "u1", "is_new_device": True}, 120.0)
+        ev = c.on_transaction(txn(amount=100.0), 150.0)
+        assert ev is not None
+        assert ev["event_type"] == "COMPLEX_CORRELATION"
+        assert set(ev["signals"]) == {"anomalous_behavior", "device_change"}
+        assert ev["signal_count"] == 2
+
+    def test_silent_below_threshold_and_outside_horizon(self):
+        c = MultiStreamCorrelator(horizon_s=300.0, min_signals=2)
+        c.on_behavior({"user_id": "u1", "anomalous_login": True}, 100.0)
+        assert c.on_transaction(txn(), 150.0) is None     # 1 signal only
+        c.on_device({"user_id": "u1", "is_new_device": True}, 110.0)
+        assert c.on_transaction(txn(), 9999.0) is None    # horizon expired
+
+    def test_large_amount_counts_as_signal(self):
+        c = MultiStreamCorrelator(min_signals=2)
+        c.on_network({"user_id": "u1", "is_vpn": True}, 10.0)
+        ev = c.on_transaction(txn(amount=9000.0), 20.0)
+        assert ev and set(ev["signals"]) == {"risky_network", "large_amount"}
+
+    def test_sweep_evicts_stale_users(self):
+        c = MultiStreamCorrelator(horizon_s=300.0, sweep_interval_events=5)
+        for i in range(4):
+            c.on_behavior({"user_id": f"old{i}", "anomalous_login": True},
+                          100.0)
+        # 5th push is far in the future -> triggers the sweep, old users go
+        c.on_behavior({"user_id": "fresh"}, 10_000.0)
+        assert list(c._behavior) == ["fresh"]

@@ -1,0 +1,272 @@
+"""Windowed analytics tests (stream/windows.py vs WindowProcessor.java
+semantics)."""
+
+import math
+
+import pytest
+
+from realtime_fraud_detection_tpu.stream.windows import (
+    SessionWindow,
+    SlidingWindow,
+    TumblingWindow,
+    WindowedAnalytics,
+    amount_bucket,
+    amount_cluster_key,
+    amount_cluster_windows,
+    fraud_pattern_key,
+    fraud_pattern_windows,
+    geo_cluster_windows,
+    geo_grid_key,
+    high_frequency_windows,
+    merchant_pattern_windows,
+    user_session_windows,
+    user_velocity_windows,
+)
+
+
+def txn(user="u1", merchant="m1", amount=50.0, fraud=False, score=0.0,
+        payment="credit_card", category="retail", lat=37.5, lon=-122.3):
+    return {
+        "user_id": user, "merchant_id": merchant, "amount": amount,
+        "is_fraud": fraud, "fraud_score": score, "payment_method": payment,
+        "merchant_category": category,
+        "geolocation": {"lat": lat, "lon": lon},
+    }
+
+
+class TestAssigners:
+    def test_tumbling(self):
+        assert TumblingWindow(300.0).assign(601.0) == [(600.0, 900.0)]
+
+    def test_sliding_covers_event(self):
+        wins = SlidingWindow(300.0, 60.0).assign(301.0)
+        assert len(wins) == 5                      # size/slide overlapping
+        for s, e in wins:
+            assert s <= 301.0 < e
+            assert e - s == 300.0
+
+    def test_session_is_point_window(self):
+        assert SessionWindow(1800.0).assign(10.0) == [(10.0, 1810.0)]
+
+
+class TestKeySelectors:
+    def test_geo_grid(self):
+        assert geo_grid_key(txn(lat=37.7, lon=-122.4)) == "geo_37_-123"
+        assert geo_grid_key({"geolocation": {}}) == "unknown"
+        assert geo_grid_key({}) == "unknown"
+
+    def test_amount_buckets(self):
+        # FraudPatternKeySelector.getAmountBucket thresholds
+        assert amount_bucket(5) == "micro"
+        assert amount_bucket(50) == "small"
+        assert amount_bucket(400) == "medium"
+        assert amount_bucket(1500) == "large"
+        assert amount_bucket(9500) == "very_large"
+        assert amount_bucket(20_000) == "extreme"
+
+    def test_fraud_pattern_key(self):
+        k = fraud_pattern_key(txn(amount=250.0))
+        assert k == "pattern_credit_card_retail_medium"
+
+    def test_amount_cluster_key_log_buckets(self):
+        assert amount_cluster_key({"amount": 0.0}) == "zero"
+        assert amount_cluster_key({"amount": 9500.0}) == "amount_3_9"
+        assert amount_cluster_key({"amount": 42.0}) == "amount_1_4"
+
+
+class TestUserVelocity:
+    def test_aggregate_fields(self):
+        op = user_velocity_windows()
+        t0 = 1000 * 60.0                           # minute-aligned
+        for i in range(6):
+            op.process(txn(amount=100.0, merchant=f"m{i}"), t0 + i)
+        # watermark far past: all 5 sliding windows close
+        results = op.advance_watermark(t0 + 400.0)
+        assert results
+        r = max(results, key=lambda r: r["transaction_count"])
+        assert r["user_id"] == "u1"
+        assert r["transaction_count"] == 6
+        assert r["total_amount"] == pytest.approx(600.0)
+        assert r["unique_merchant_count"] == 6
+        assert r["fraud_rate"] == 0.0
+        # 6 txns (>5) -> 0.1; amounts 600 < 1000 -> 0; diversity 1.0 -> 0
+        assert r["velocity_score"] == pytest.approx(0.1)
+
+    def test_velocity_score_factors(self):
+        """WindowProcessor.java:328-351: counts, amounts, fraud rate,
+        low merchant diversity."""
+        op = user_velocity_windows()
+        t0 = 0.0
+        for i in range(21):                        # >20 txns, one merchant
+            op.process(txn(amount=600.0, fraud=(i < 7)), t0 + i)
+        r = max(op.advance_watermark(t0 + 400.0),
+                key=lambda r: r["transaction_count"])
+        # 0.4 (count>20) + 0.3 (amount>10k) + 7/21*0.4 + 0.2 (diversity<0.2)
+        assert r["velocity_score"] == pytest.approx(
+            min(1.0, 0.4 + 0.3 + (7 / 21) * 0.4 + 0.2))
+
+
+class TestMerchantPatterns:
+    def test_std_dev_matches_population(self):
+        import numpy as np
+
+        op = merchant_pattern_windows()
+        amounts = [10.0, 20.0, 30.0, 100.0, 5.0]
+        for i, a in enumerate(amounts):
+            op.process(txn(amount=a, user=f"u{i}"), 100.0 + i)
+        (r,) = op.advance_watermark(100.0 + 3600.0 + 20.0)
+        assert r["merchant_id"] == "m1"
+        assert r["amount_std_dev"] == pytest.approx(np.std(amounts))
+        assert r["unique_user_count"] == 5
+
+    def test_risk_score_low_user_diversity(self):
+        op = merchant_pattern_windows()
+        for i in range(30):                        # one user hammering
+            op.process(txn(user="u1", amount=10.0), 50.0 + i)
+        (r,) = op.advance_watermark(7300.0)
+        assert r["risk_score"] == pytest.approx(0.3)   # diversity < 0.1
+
+    def test_welford_merge(self):
+        """Chan's merge must equal single-pass accumulation."""
+        import numpy as np
+
+        from realtime_fraud_detection_tpu.stream.windows import (
+            MerchantPatternAggregate,
+        )
+
+        agg = MerchantPatternAggregate()
+        a, b = agg.create(), agg.create()
+        xs = [3.0, 7.0, 1.0, 9.0]
+        ys = [100.0, 2.0, 5.0]
+        for i, x in enumerate(xs):
+            agg.add(a, txn(amount=x), float(i))
+        for i, y in enumerate(ys):
+            agg.add(b, txn(amount=y), float(i))
+        merged = agg.merge(a, b)
+        r = agg.result(merged, "m1", (0.0, 3600.0))
+        assert r["amount_std_dev"] == pytest.approx(np.std(xs + ys))
+
+
+class TestSessions:
+    def test_session_merges_on_gap(self):
+        op = user_session_windows()
+        fired = []
+        fired += op.process(txn(amount=10.0), 0.0)
+        fired += op.process(txn(amount=20.0), 60.0)  # same session (<30m gap)
+        # >30m later: new session; watermark passing closes the first
+        fired += op.process(txn(amount=30.0), 5000.0)
+        assert len(op) == 1
+        assert len(fired) == 1
+        assert fired[0]["transaction_count"] == 2
+        assert fired[0]["session_duration_s"] == pytest.approx(60.0)
+        (second,) = op.flush()
+        assert second["transaction_count"] == 1
+
+    def test_bridge_event_merges_two_sessions(self):
+        from realtime_fraud_detection_tpu.stream.windows import (
+            SessionWindow,
+            UserSessionAggregate,
+            WindowOperator,
+        )
+
+        # huge out-of-orderness so out-of-order arrival exercises the merge
+        op = WindowOperator(
+            "s", lambda t: str(t.get("user_id")), SessionWindow(1800.0),
+            UserSessionAggregate(), out_of_orderness_s=1e6)
+        op.process(txn(), 0.0)
+        op.process(txn(), 3000.0)                  # separate session
+        assert len(op) == 2
+        op.process(txn(), 1600.0)                  # bridges both (gap 1800)
+        assert len(op) == 1
+        (r,) = op.flush()
+        assert r["transaction_count"] == 3
+
+
+class TestHighFrequency:
+    def test_count_trigger_fires_early(self):
+        op = high_frequency_windows(trigger_count=10)
+        fired = []
+        for i in range(25):
+            fired.extend(op.process(txn(), 10.0 + i * 0.1))
+        # two early fires at counts 10 and 20, window still open
+        assert len(fired) == 2
+        assert fired[0]["transaction_count"] == 10
+        assert fired[1]["transaction_count"] == 20
+        assert fired[0]["alert_type"] == "HIGH_FREQUENCY"
+        assert fired[1]["transactions_per_second"] > 1.0
+
+
+class TestWatermarks:
+    def test_late_event_dropped_only_when_all_windows_closed(self):
+        op = geo_cluster_windows()                 # tumbling 15m, ooo 10s
+        op.process(txn(), 1000.0)
+        # event in a closed window (watermark = max_ts - 10)
+        op.process(txn(), 5000.0)                  # advances watermark
+        fired = op.process(txn(), 100.0 - 900.0)   # far in the past
+        assert op.late_dropped == 1
+        assert all(r["window_end"] <= op.watermark for r in fired)
+
+    def test_slightly_late_event_still_counts(self):
+        op = geo_cluster_windows()
+        op.process(txn(), 900.0 + 100.0)           # window (900, 1800)
+        op.process(txn(), 900.0 + 105.0)
+        op.process(txn(), 900.0 + 98.0)            # behind max_ts, in window
+        assert op.late_dropped == 0
+        (r,) = op.advance_watermark(3000.0)
+        assert r["transaction_count"] == 3
+
+
+class TestComposite:
+    def test_all_seven_operators_fire(self):
+        from realtime_fraud_detection_tpu.stream.transport import InMemoryBroker
+
+        broker = InMemoryBroker()
+        analytics = WindowedAnalytics(broker)
+        t0 = 0.0
+        for i in range(200):
+            analytics.process(
+                txn(user=f"u{i % 5}", merchant=f"m{i % 3}",
+                    amount=10.0 + (i % 7) * 300.0), t0 + i * 30.0)
+        out = analytics.flush()
+        names = set(out)
+        assert {"user_velocity", "merchant_patterns", "user_sessions",
+                "geo_clusters", "fraud_patterns", "high_frequency",
+                "amount_clusters"} <= names | set(analytics.stats())
+        # results actually landed on the stream-processing topics
+        vel = broker.consumer(["velocity-checks"], "t").poll(10_000)
+        assert vel
+        stats = analytics.stats()
+        assert stats["user_velocity"]["fired"] > 0
+
+
+class TestJobIntegration:
+    def test_stream_job_feeds_analytics(self):
+        from realtime_fraud_detection_tpu.scoring import (
+            FraudScorer,
+            ScorerConfig,
+        )
+        from realtime_fraud_detection_tpu.sim.simulator import (
+            TransactionGenerator,
+        )
+        from realtime_fraud_detection_tpu.stream import (
+            InMemoryBroker,
+            JobConfig,
+            StreamJob,
+        )
+        from realtime_fraud_detection_tpu.stream import topics as T
+
+        gen = TransactionGenerator(num_users=20, num_merchants=10, seed=5,
+                                   tps=2.0)
+        broker = InMemoryBroker()
+        scorer = FraudScorer(scorer_config=ScorerConfig(text_len=32))
+        scorer.seed_profiles(gen.users.profiles(), gen.merchants.profiles())
+        job = StreamJob(broker, scorer,
+                        JobConfig(max_batch=64, enable_analytics=True))
+        records = gen.generate_batch(120)          # 60s of simulated traffic
+        broker.produce_batch(T.TRANSACTIONS, records,
+                             key_fn=lambda r: str(r["user_id"]))
+        assert job.run_until_drained(now=1000.0) == 120
+        job.analytics.flush()
+        stats = job.analytics.stats()
+        assert stats["user_velocity"]["fired"] > 0
+        assert stats["merchant_patterns"]["fired"] > 0
