@@ -1,0 +1,5 @@
+"""``python -m realtime_fraud_detection_tpu`` entry point."""
+
+from realtime_fraud_detection_tpu.cli import main
+
+raise SystemExit(main())

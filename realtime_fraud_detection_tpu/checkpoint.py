@@ -48,10 +48,26 @@ _CHECKPOINTER = None
 def _orbax_checkpointer():
     global _CHECKPOINTER
     if _CHECKPOINTER is None:
+        import atexit
+
         import orbax.checkpoint as ocp
 
         _CHECKPOINTER = ocp.StandardCheckpointer()
+        # flush + join orbax's async I/O threads before the interpreter
+        # tears down (otherwise a save racing process exit logs
+        # "cannot schedule new futures after interpreter shutdown")
+        atexit.register(_close_checkpointer)
     return _CHECKPOINTER
+
+
+def _close_checkpointer() -> None:
+    global _CHECKPOINTER
+    if _CHECKPOINTER is not None:
+        try:
+            _CHECKPOINTER.close()
+        except Exception:  # noqa: BLE001 - best-effort at exit
+            pass
+        _CHECKPOINTER = None
 
 
 @dataclasses.dataclass
@@ -105,7 +121,12 @@ class CheckpointManager:
         d.mkdir(parents=True)
         if params is not None:
             # StandardCheckpointer wants the target dir absent
-            self._orbax().save(str((d / _PARAMS).absolute()), params)
+            ckptr = self._orbax()
+            ckptr.save(str((d / _PARAMS).absolute()), params)
+            # block until the async commit lands: the manifest below must
+            # only exist once params are durable, and a short-lived process
+            # (CLI train) must not exit with the commit still in flight
+            ckptr.wait_until_finished()
         if host_state is not None:
             with open(d / _HOST_STATE, "wb") as f:
                 pickle.dump(host_state, f, protocol=pickle.HIGHEST_PROTOCOL)

@@ -1,0 +1,326 @@
+"""Command-line entry points: simulate / run-job / serve / train / bench /
+health-check / topics.
+
+The reference's operational surface is a pile of shell scripts and service
+mains (simulator.py:478-503 argparse, FraudDetectionJob.main + JobConfig
+CLI flags JobConfig.java:69-146, uvicorn in main.py:343,
+scripts/setup/{start-all,health-check,start-simulation}.sh). Here it is one
+typed CLI over the framework:
+
+    python -m realtime_fraud_detection_tpu simulate --count 1000
+    python -m realtime_fraud_detection_tpu run-job --count 10000 --analytics
+    python -m realtime_fraud_detection_tpu serve --port 8000
+    python -m realtime_fraud_detection_tpu train --rows 20000 --out ./ckpt
+    python -m realtime_fraud_detection_tpu bench
+    python -m realtime_fraud_detection_tpu health-check --url http://...
+    python -m realtime_fraud_detection_tpu topics
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import Any, Dict, Optional
+
+
+def _add_sim_args(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--users", type=int, default=10_000,
+                   help="user pool size (simulator.py:481)")
+    p.add_argument("--merchants", type=int, default=5_000,
+                   help="merchant pool size (:482)")
+    p.add_argument("--tps", type=float, default=1000.0,
+                   help="simulated event-time rate (:481)")
+    p.add_argument("--seed", type=int, default=42)
+
+
+def cmd_simulate(args: argparse.Namespace) -> int:
+    """Generate transactions as JSON lines (simulator.py main() analog —
+    minus the sleep(1/tps) pacing loop; event time is synthesized)."""
+    from realtime_fraud_detection_tpu.sim.simulator import TransactionGenerator
+
+    gen = TransactionGenerator(num_users=args.users,
+                               num_merchants=args.merchants,
+                               seed=args.seed, tps=args.tps)
+    out = sys.stdout if args.output == "-" else open(args.output, "w")
+    try:
+        n_fraud = 0
+        remaining = args.count
+        while remaining > 0:
+            for txn in gen.generate_batch(min(1000, remaining)):
+                n_fraud += bool(txn.get("is_fraud"))
+                out.write(json.dumps(txn) + "\n")
+            remaining -= min(1000, remaining)
+    finally:
+        if out is not sys.stdout:
+            out.close()
+    print(f"generated {args.count} txns ({n_fraud} fraud)", file=sys.stderr)
+    return 0
+
+
+def cmd_run_job(args: argparse.Namespace) -> int:
+    """End-to-end streaming job: simulator -> broker -> microbatched TPU
+    scorer -> output topics, with checkpointing + durable job metadata."""
+    from realtime_fraud_detection_tpu.checkpoint import (
+        CheckpointManager,
+        snapshot_scorer_host_state,
+    )
+    from realtime_fraud_detection_tpu.scoring import FraudScorer, ScorerConfig
+    from realtime_fraud_detection_tpu.sim.simulator import TransactionGenerator
+    from realtime_fraud_detection_tpu.state import MetadataStore
+    from realtime_fraud_detection_tpu.stream import (
+        InMemoryBroker,
+        JobConfig,
+        StreamJob,
+    )
+    from realtime_fraud_detection_tpu.stream import topics as T
+
+    gen = TransactionGenerator(num_users=args.users,
+                               num_merchants=args.merchants,
+                               seed=args.seed, tps=args.tps)
+    broker = InMemoryBroker()
+    scorer = FraudScorer(scorer_config=ScorerConfig())
+    scorer.seed_profiles(gen.users.profiles(), gen.merchants.profiles())
+    job = StreamJob(broker, scorer, JobConfig(
+        max_batch=args.batch, enable_analytics=args.analytics))
+
+    metadata: Optional[MetadataStore] = None
+    ckpt: Optional[CheckpointManager] = None
+    job_id = f"job-{args.seed}"
+    if args.metadata_db:
+        metadata = MetadataStore(args.metadata_db)
+        metadata.register_job(job_id, "fraud-detection-job", parallelism=1)
+        metadata.put_profiles(gen.users.profiles(), gen.merchants.profiles())
+    if args.checkpoint_dir:
+        ckpt = CheckpointManager(args.checkpoint_dir)
+
+    t0 = time.perf_counter()
+    produced = scored = step = 0
+    try:
+        while produced < args.count:
+            chunk = min(args.count - produced, 10_000)
+            records = gen.generate_batch(chunk)
+            broker.produce_batch(T.TRANSACTIONS, records,
+                                 key_fn=lambda r: str(r["user_id"]))
+            produced += chunk
+            scored += job.run_until_drained()
+            step += 1
+            if ckpt is not None:
+                t_ck = time.perf_counter()
+                path = ckpt.save(
+                    step, params=scorer.models,
+                    host_state=snapshot_scorer_host_state(scorer),
+                    offsets=job.consumer.positions())
+                if metadata is not None:
+                    metadata.record_checkpoint(
+                        job_id, step, str(path),
+                        duration_ms=(time.perf_counter() - t_ck) * 1e3)
+    except BaseException:
+        if metadata is not None:
+            metadata.set_job_status(job_id, "FAILED")
+            metadata.close()
+        raise
+    if job.analytics is not None:
+        job.analytics.flush()
+    dt = time.perf_counter() - t0
+    if metadata is not None:
+        metadata.set_job_status(job_id, "FINISHED")
+        metadata.close()
+
+    summary: Dict[str, Any] = {
+        "scored": scored,
+        "wall_s": round(dt, 3),
+        "txn_per_s": round(scored / dt, 1),
+        "counters": job.counters,
+    }
+    if job.analytics is not None:
+        summary["analytics"] = {
+            k: v["fired"] for k, v in job.analytics.stats().items()}
+    print(json.dumps(summary))
+    return 0
+
+
+def cmd_serve(args: argparse.Namespace) -> int:
+    """Run the scoring service (reference main.py:343 uvicorn analog)."""
+    from realtime_fraud_detection_tpu.serving.app import ServingApp
+    from realtime_fraud_detection_tpu.utils.config import Config
+
+    config = Config.from_file(args.config) if args.config else Config()
+    if args.host:
+        config.serving.host = args.host
+    if args.port is not None:
+        config.serving.port = args.port
+    app = ServingApp(config=config)
+    print(f"serving on {config.serving.host}:{config.serving.port}",
+          file=sys.stderr)
+    app.run_forever()
+    return 0
+
+
+def cmd_train(args: argparse.Namespace) -> int:
+    """Train the tree models on synthetic data and save a checkpoint
+    (model_trainer.py analog: XGBoost + IsolationForest, AUC eval,
+    artifact save — :41-295)."""
+    import numpy as np
+
+    from realtime_fraud_detection_tpu.checkpoint import CheckpointManager
+    from realtime_fraud_detection_tpu.features.extract import extract_features
+    from realtime_fraud_detection_tpu.models.isolation_forest import (
+        IsolationForestTrainer,
+    )
+    from realtime_fraud_detection_tpu.sim.simulator import TransactionGenerator
+    from realtime_fraud_detection_tpu.training import GBDTTrainer
+
+    gen = TransactionGenerator(num_users=args.users,
+                               num_merchants=args.merchants, seed=args.seed)
+    batch, labels = gen.generate_encoded(args.rows)
+    x = np.asarray(extract_features(batch))
+    y = labels["is_fraud"].astype(np.float32)
+    split = int(0.8 * len(y))
+
+    trees = GBDTTrainer(n_estimators=args.trees,
+                        seed=args.seed).fit(x[:split], y[:split])
+    from realtime_fraud_detection_tpu.models.trees import tree_ensemble_logits
+
+    logits = np.asarray(tree_ensemble_logits(trees, x[split:]))
+    auc = _auc(y[split:], logits)
+
+    iforest = IsolationForestTrainer(seed=args.seed).fit(
+        x[:split][y[:split] == 0])          # fit on normals only (:235-276)
+
+    mgr = CheckpointManager(args.out)
+    path = mgr.save(0, params={"trees": trees, "iforest": iforest},
+                    metadata={"rows": args.rows, "auc": auc,
+                              "fraud_rate": float(y.mean())})
+    print(json.dumps({"auc": round(auc, 4),
+                      "fraud_rate": round(float(y.mean()), 4),
+                      "checkpoint": str(path)}))
+    return 0
+
+
+def _auc(y: "Any", score: "Any") -> float:
+    """Mann-Whitney AUC with average ranks for ties (tied logits are common
+    with few trees; ordinal ranks would bias the estimate)."""
+    import numpy as np
+
+    score = np.asarray(score, float)
+    order = np.argsort(score)
+    rank = np.empty(len(score), float)
+    sorted_scores = score[order]
+    i = 0
+    while i < len(score):
+        j = i
+        while j + 1 < len(score) and sorted_scores[j + 1] == sorted_scores[i]:
+            j += 1
+        rank[order[i:j + 1]] = (i + j) / 2.0 + 1.0   # average 1-based rank
+        i = j + 1
+    pos = np.asarray(y) > 0.5
+    n_pos, n_neg = int(pos.sum()), int((~pos).sum())
+    if not n_pos or not n_neg:
+        return 0.5
+    return float((rank[pos].sum() - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg))
+
+
+def cmd_bench(args: argparse.Namespace) -> int:
+    import importlib.util
+    from pathlib import Path
+
+    # bench.py lives at the repo root (driver contract), outside the
+    # package — load it by path so the command works from any cwd
+    bench_path = Path(__file__).resolve().parent.parent / "bench.py"
+    spec = importlib.util.spec_from_file_location("bench", bench_path)
+    if spec is None or spec.loader is None:
+        print(f"bench.py not found at {bench_path}", file=sys.stderr)
+        return 1
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    bench.main()
+    return 0
+
+
+def cmd_health_check(args: argparse.Namespace) -> int:
+    """Probe a running scoring service (health-check.sh analog)."""
+    import urllib.error
+    import urllib.request
+
+    url = args.url.rstrip("/") + "/health"
+    try:
+        with urllib.request.urlopen(url, timeout=args.timeout) as resp:
+            body = json.loads(resp.read())
+    except (urllib.error.URLError, OSError, json.JSONDecodeError) as e:
+        print(json.dumps({"healthy": False, "error": str(e)}))
+        return 1
+    healthy = body.get("status") == "healthy"
+    print(json.dumps({"healthy": healthy, **body}))
+    return 0 if healthy else 1
+
+
+def cmd_topics(args: argparse.Namespace) -> int:
+    """Print the topic contract (create-topics.sh:101-160 analog)."""
+    from realtime_fraud_detection_tpu.stream.topics import TOPIC_SPECS
+
+    for t in TOPIC_SPECS:
+        flag = " compacted" if t.compacted else ""
+        print(f"{t.name:28s} partitions={t.partitions}{flag}")
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="realtime_fraud_detection_tpu",
+        description="TPU-native realtime fraud detection framework")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    sp = sub.add_parser("simulate", help="generate transaction JSON lines")
+    _add_sim_args(sp)
+    sp.add_argument("--count", type=int, default=1000)
+    sp.add_argument("--output", default="-")
+    sp.set_defaults(fn=cmd_simulate)
+
+    sp = sub.add_parser("run-job", help="run the streaming scoring job")
+    _add_sim_args(sp)
+    sp.add_argument("--count", type=int, default=10_000)
+    sp.add_argument("--batch", type=int, default=256)
+    sp.add_argument("--analytics", action="store_true",
+                    help="attach the windowed-analytics stage")
+    sp.add_argument("--checkpoint-dir", default="",
+                    help="save params+state checkpoints per chunk")
+    sp.add_argument("--metadata-db", default="",
+                    help="SQLite path for durable job/checkpoint metadata")
+    sp.set_defaults(fn=cmd_run_job)
+
+    sp = sub.add_parser("serve", help="run the scoring HTTP service")
+    sp.add_argument("--host", default="")
+    sp.add_argument("--port", type=int, default=None)
+    sp.add_argument("--config", default="", help="JSON config file")
+    sp.set_defaults(fn=cmd_serve)
+
+    sp = sub.add_parser("train", help="train tree models on synthetic data")
+    _add_sim_args(sp)
+    sp.add_argument("--rows", type=int, default=10_000,
+                    help="synthetic rows (model_trainer.py:123)")
+    sp.add_argument("--trees", type=int, default=100)
+    sp.add_argument("--out", default="./checkpoints")
+    sp.set_defaults(fn=cmd_train)
+
+    sp = sub.add_parser("bench", help="run the TPU benchmark")
+    sp.set_defaults(fn=cmd_bench)
+
+    sp = sub.add_parser("health-check", help="probe a running service")
+    sp.add_argument("--url", default="http://127.0.0.1:8000")
+    sp.add_argument("--timeout", type=float, default=5.0)
+    sp.set_defaults(fn=cmd_health_check)
+
+    sp = sub.add_parser("topics", help="print the topic contract")
+    sp.set_defaults(fn=cmd_topics)
+    return p
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

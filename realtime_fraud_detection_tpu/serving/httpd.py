@@ -62,9 +62,11 @@ class JsonResponse:
 class HttpServer:
     """Route table + asyncio server. Routes are (METHOD, path) exact-match."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 drain_grace_s: float = 5.0):
         self.host = host
         self.port = port
+        self.drain_grace_s = drain_grace_s
         self._routes: Dict[Tuple[str, str], Handler] = {}
         self._server: Optional[asyncio.AbstractServer] = None
         # task -> True while parked waiting for the next request (idle)
@@ -94,7 +96,15 @@ class HttpServer:
             for task, idle in list(self._conns.items()):
                 if idle:
                     task.cancel()
-            await self._server.wait_closed()
+            try:
+                await asyncio.wait_for(self._server.wait_closed(),
+                                       timeout=self.drain_grace_s)
+            except asyncio.TimeoutError:
+                # grace expired: a handler is stuck mid-request (e.g. a
+                # slow-loris body that never arrives) — cancel everything
+                for task in list(self._conns):
+                    task.cancel()
+                await self._server.wait_closed()
             self._server = None
             self._closing = False
 

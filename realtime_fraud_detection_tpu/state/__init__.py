@@ -9,3 +9,10 @@ from realtime_fraud_detection_tpu.state.history import (  # noqa: F401
     UserHistoryStore,
     EntityGraphStore,
 )
+from realtime_fraud_detection_tpu.state.feature_store import (  # noqa: F401
+    FeatureStats,
+    FeatureStore,
+)
+from realtime_fraud_detection_tpu.state.metadata import (  # noqa: F401
+    MetadataStore,
+)

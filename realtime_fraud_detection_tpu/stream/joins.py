@@ -78,6 +78,7 @@ class WindowJoin:
         self._left_max_ts = -math.inf
         self._right_max_ts = -math.inf
         self._fired_wm = -math.inf    # watermark at the last eviction scan
+        self._min_open_end = math.inf  # earliest buffered window end
         self.joined = 0
         self.late_dropped = 0
 
@@ -97,6 +98,7 @@ class WindowJoin:
             slot = self._buffers.get((key, (start, end)))
             if slot is None:
                 slot = self._buffers[(key, (start, end))] = ([], [])
+                self._min_open_end = min(self._min_open_end, end)
             slot[side].append(event)
         return self.advance_watermark()
 
@@ -110,8 +112,11 @@ class WindowJoin:
 
     def advance_watermark(self) -> List[Dict[str, Any]]:
         wm = self.watermark
-        # fast exit when the joint watermark hasn't advanced (hot path)
-        if wm <= self._fired_wm:
+        # fast exit when the joint watermark hasn't advanced or hasn't yet
+        # crossed the earliest buffered window's end (hot path)
+        if wm <= self._fired_wm or wm < self._min_open_end:
+            if wm > self._fired_wm:
+                self._fired_wm = wm
             return []
         self._fired_wm = wm
         out: List[Dict[str, Any]] = []
@@ -123,6 +128,8 @@ class WindowJoin:
                 for re in rights:
                     out.append(self.join_fn(le, re))
                     self.joined += 1
+        self._min_open_end = min(
+            (kw[1][1] for kw in self._buffers), default=math.inf)
         return out
 
     def flush(self) -> List[Dict[str, Any]]:
@@ -134,6 +141,7 @@ class WindowJoin:
                 for re in rights:
                     out.append(self.join_fn(le, re))
                     self.joined += 1
+        self._min_open_end = math.inf
         return out
 
     def __len__(self) -> int:

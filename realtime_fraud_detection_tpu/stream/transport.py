@@ -217,6 +217,11 @@ class Consumer:
     def commit(self) -> None:
         self.broker.commit(self.group_id, dict(self._position))
 
+    def positions(self) -> Dict[str, int]:
+        """JSON-safe snapshot of current read positions
+        ("topic:partition" -> next offset) for checkpoint manifests."""
+        return {f"{t}:{p}": pos for (t, p), pos in self._position.items()}
+
     def lag(self) -> int:
         return sum(self.broker.lag(self.group_id, t) for t in self.topics)
 

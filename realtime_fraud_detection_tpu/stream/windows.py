@@ -485,6 +485,9 @@ class WindowOperator:
         self._windows: Dict[Tuple[str, Tuple[float, float]], List[Any]] = {}
         self.max_event_ts = -math.inf
         self._fired_wm = -math.inf    # watermark at the last eviction scan
+        # earliest end among open windows (may be stale-low after session
+        # merges — that only costs an occasional no-op scan, never misses)
+        self._min_open_end = math.inf
         self.late_dropped = 0
         self.fired = 0
 
@@ -513,6 +516,7 @@ class WindowOperator:
                 slot = self._windows.get((key, window))
                 if slot is None:
                     slot = self._windows[(key, window)] = [self.agg.create(), 0]
+                    self._min_open_end = min(self._min_open_end, window[1])
                 self.agg.add(slot[0], txn, ts)
                 slot[1] += 1
                 if self.trigger_count and slot[1] >= self.trigger_count:
@@ -539,15 +543,20 @@ class WindowOperator:
             start = min(start, k_w[1][0])
             end = max(end, k_w[1][1])
         self._windows[(key, (start, end))] = [acc, 0]
+        self._min_open_end = min(self._min_open_end, end)
 
     def advance_watermark(self, event_ts: Optional[float] = None
                           ) -> List[Dict[str, Any]]:
         if event_ts is not None:
             self.max_event_ts = max(self.max_event_ts, event_ts)
         wm = self.watermark
-        # hot-path fast exit: most events don't move the watermark, so the
-        # open-window scan would find nothing new to evict
-        if wm <= self._fired_wm:
+        # hot-path fast exits: nothing to do unless the watermark moved AND
+        # crossed the earliest open window's end (in-order streams advance
+        # the watermark every event; without the second check each event
+        # would pay a full open-window scan)
+        if wm <= self._fired_wm or wm < self._min_open_end:
+            if wm > self._fired_wm:
+                self._fired_wm = wm
             return []
         self._fired_wm = wm
         fired = []
@@ -557,6 +566,8 @@ class WindowOperator:
             acc, _ = self._windows.pop((key, window))
             fired.append(self.agg.result(acc, key, window))
             self.fired += 1
+        self._min_open_end = min(
+            (kw[1][1] for kw in self._windows), default=math.inf)
         return fired
 
     def flush(self) -> List[Dict[str, Any]]:
@@ -566,6 +577,7 @@ class WindowOperator:
             acc, _ = self._windows.pop((key, window))
             fired.append(self.agg.result(acc, key, window))
             self.fired += 1
+        self._min_open_end = math.inf
         return fired
 
     def __len__(self) -> int:

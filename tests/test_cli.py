@@ -1,0 +1,87 @@
+"""CLI tests. The heavyweight commands (run-job, serve, bench) are driven
+in their own layers' tests; here the parser contract, simulate, train, and
+health-check paths are exercised in-process."""
+
+import json
+
+import pytest
+
+from realtime_fraud_detection_tpu.cli import _auc, build_parser, main
+
+
+class TestParser:
+    def test_requires_command(self):
+        with pytest.raises(SystemExit):
+            build_parser().parse_args([])
+
+    @pytest.mark.parametrize("argv", [
+        ["simulate", "--count", "10"],
+        ["run-job", "--count", "100", "--analytics"],
+        ["serve", "--port", "9999"],
+        ["train", "--rows", "500"],
+        ["bench"],
+        ["health-check", "--url", "http://x"],
+        ["topics"],
+    ])
+    def test_all_subcommands_parse(self, argv):
+        args = build_parser().parse_args(argv)
+        assert callable(args.fn)
+
+
+class TestSimulate:
+    def test_writes_jsonl(self, tmp_path, capsys):
+        out = tmp_path / "txns.jsonl"
+        rc = main(["simulate", "--count", "120", "--users", "50",
+                   "--merchants", "20", "--output", str(out)])
+        assert rc == 0
+        lines = out.read_text().strip().split("\n")
+        assert len(lines) == 120
+        txn = json.loads(lines[0])
+        assert {"transaction_id", "user_id", "merchant_id", "amount",
+                "timestamp"} <= set(txn)
+
+
+class TestTopics:
+    def test_lists_contract(self, capsys):
+        assert main(["topics"]) == 0
+        out = capsys.readouterr().out
+        assert "payment-transactions" in out and "partitions=12" in out
+
+
+class TestTrain:
+    def test_trains_and_checkpoints(self, tmp_path, capsys):
+        rc = main(["train", "--rows", "2000", "--trees", "8",
+                   "--users", "200", "--merchants", "50",
+                   "--out", str(tmp_path / "ckpt")])
+        assert rc == 0
+        report = json.loads(capsys.readouterr().out.strip().split("\n")[-1])
+        assert report["auc"] > 0.7          # trees learn the synthetic rule
+        assert (tmp_path / "ckpt" / "step_0000000000" / "manifest.json").exists()
+
+
+class TestHealthCheck:
+    def test_unreachable_is_unhealthy(self, capsys):
+        rc = main(["health-check", "--url", "http://127.0.0.1:1",
+                   "--timeout", "0.2"])
+        assert rc == 1
+        assert json.loads(capsys.readouterr().out)["healthy"] is False
+
+
+class TestAuc:
+    def test_auc_orders_correctly(self):
+        import numpy as np
+
+        y = np.array([0, 0, 1, 1], float)
+        assert _auc(y, np.array([0.1, 0.2, 0.8, 0.9])) == 1.0
+        assert _auc(y, np.array([0.9, 0.8, 0.2, 0.1])) == 0.0
+        assert _auc(np.zeros(4), np.ones(4)) == 0.5
+
+
+class TestAucTies:
+    def test_tied_scores_average_ranks(self):
+        import numpy as np
+
+        # all-tied scores carry no information -> AUC must be 0.5 in both
+        # label orders (ordinal ranks would give 1.0 / 0.0)
+        assert _auc(np.array([0.0, 1.0]), np.array([0.5, 0.5])) == 0.5
+        assert _auc(np.array([1.0, 0.0]), np.array([0.5, 0.5])) == 0.5

@@ -1,0 +1,162 @@
+"""FeatureStore + MetadataStore tests (FeatureStore.java / init.sql
+semantics, with the reference's store-nothing bug fixed)."""
+
+import numpy as np
+import pytest
+
+from realtime_fraud_detection_tpu.state import FeatureStore, MetadataStore
+
+
+class TestFeatureRegistry:
+    def test_register_and_version_bump(self):
+        fs = FeatureStore()
+        m1 = fs.register_feature("amount", "NUMERICAL", "txn amount", now=10.0)
+        assert m1["version"] == 1 and m1["created_at"] == 10.0
+        m2 = fs.register_feature("amount", "NUMERICAL", "usd amount",
+                                 properties={"unit": "usd"}, now=20.0)
+        assert m2["version"] == 2
+        assert m2["created_at"] == 10.0 and m2["updated_at"] == 20.0
+        assert m2["properties"] == {"unit": "usd"}
+
+    def test_rejects_unknown_type(self):
+        with pytest.raises(ValueError, match="unknown feature type"):
+            FeatureStore().register_feature("x", "COMPLEX")
+
+    def test_registered_includes_the_64_contract(self):
+        from realtime_fraud_detection_tpu.features.extract import FEATURE_NAMES
+
+        fs = FeatureStore()
+        fs.register_feature("custom_feature")
+        names = fs.registered_features()
+        assert set(FEATURE_NAMES) <= names
+        assert "custom_feature" in names
+
+
+class TestFeatureValues:
+    def test_store_and_retrieve_strips_internal_fields(self):
+        """The reference's storeFeatureValues never stored anything
+        (FeatureStore.java:122-146); ours must round-trip."""
+        fs = FeatureStore()
+        fs.store_feature_values("t1", "transaction",
+                                {"amount": 42.0, "is_fraud": False}, now=100.0)
+        got = fs.get_feature_values("t1", "transaction", now=101.0)
+        assert got == {"amount": 42.0, "is_fraud": False}
+
+    def test_values_expire_after_ttl(self):
+        fs = FeatureStore()
+        fs.store_feature_values("t1", "transaction", {"a": 1.0}, now=0.0)
+        assert fs.get_feature_values("t1", "transaction", now=7100.0)
+        assert fs.get_feature_values("t1", "transaction", now=7300.0) == {}
+
+    def test_batch_and_selected(self):
+        fs = FeatureStore()
+        for i in range(3):
+            fs.store_feature_values(f"e{i}", "user", {"a": i, "b": -i},
+                                    now=0.0)
+        batch = fs.get_batch_feature_values(["e0", "e2", "missing"], "user",
+                                            now=1.0)
+        assert batch["e2"] == {"a": 2, "b": -2}
+        assert batch["missing"] == {}
+        sel = fs.get_selected_features("e1", "user", ["b"], now=1.0)
+        assert sel == {"b": -1}
+
+
+class TestFeatureStatistics:
+    def test_welford_std_is_real(self):
+        """The reference drops the M2 term so std is always 0
+        (FeatureStore.java:268); ours matches numpy."""
+        fs = FeatureStore()
+        xs = [3.0, 7.0, 1.0, 9.0, 100.0]
+        for i, x in enumerate(xs):
+            fs.store_feature_values(f"t{i}", "transaction", {"amount": x},
+                                    now=float(i))
+        s = fs.get_feature_statistics("amount")
+        assert s["count"] == 5
+        assert s["mean"] == pytest.approx(np.mean(xs))
+        assert s["std"] == pytest.approx(np.std(xs))
+        assert s["min"] == 1.0 and s["max"] == 100.0
+
+    def test_categorical_and_null_tracking(self):
+        fs = FeatureStore()
+        for v in ["visa", "visa", "amex", None, True]:
+            fs.store_feature_values("e", "txn", {"card": v}, now=0.0)
+        s = fs.get_feature_statistics("card")
+        assert s["categorical_counts"] == {"visa": 2, "amex": 1, "true": 1}
+        assert s["null_rate"] == pytest.approx(1 / 5)
+
+    def test_health(self):
+        fs = FeatureStore()
+        fs.register_feature("a")
+        fs.store_feature_values("e", "u", {"a": 1}, now=0.0)
+        h = fs.health()
+        assert h["healthy"] and h["registered_features"] == 1
+        assert h["counters"]["stored"] == 1
+
+
+class TestMetadataStore:
+    def test_job_lifecycle(self):
+        md = MetadataStore()
+        md.register_job("j1", "fraud-detection-job", parallelism=8, now=1.0)
+        assert md.get_job("j1")["status"] == "RUNNING"
+        md.set_job_status("j1", "FINISHED", now=5.0)
+        job = md.get_job("j1")
+        assert job["status"] == "FINISHED" and job["end_time"] == 5.0
+
+    def test_checkpoint_records(self):
+        md = MetadataStore()
+        md.register_job("j1", "job")
+        md.record_checkpoint("j1", 1, "/ckpt/step_1", 1024, 12.5, now=2.0)
+        md.record_checkpoint("j1", 2, "/ckpt/step_2", 2048, 10.0, now=3.0)
+        md.record_checkpoint("j1", 3, "/c", status="FAILED", now=4.0)
+        assert len(md.checkpoints("j1")) == 3
+        latest = md.latest_checkpoint("j1")
+        assert latest["step"] == 2 and latest["path"] == "/ckpt/step_2"
+
+    def test_feature_values_ttl(self):
+        md = MetadataStore()
+        md.put_feature_values("txn", "t1", {"amount": 9.0}, ttl_s=100.0,
+                              now=0.0)
+        assert md.get_feature_values("txn", "t1", now=50.0) == {"amount": 9.0}
+        assert md.get_feature_values("txn", "t1", now=200.0) == {}
+        assert md.expire_feature_values(now=200.0) == 1
+
+    def test_profiles_roundtrip_and_bulk_restore(self):
+        md = MetadataStore()
+        md.put_profiles(users={"u1": {"risk_score": 0.2}},
+                        merchants={"m1": {"category": "retail"}})
+        assert md.get_user_profile("u1") == {"risk_score": 0.2}
+        allp = md.load_all_profiles()
+        assert allp["users"]["u1"]["risk_score"] == 0.2
+        assert allp["merchants"]["m1"]["category"] == "retail"
+
+    def test_persistence_across_reopen(self, tmp_path):
+        p = tmp_path / "meta.db"
+        md = MetadataStore(p)
+        md.register_job("j1", "job")
+        md.record_checkpoint("j1", 7, "/x")
+        md.close()
+        md2 = MetadataStore(p)
+        assert md2.latest_checkpoint("j1")["step"] == 7
+        md2.close()
+
+    def test_feature_registry(self):
+        md = MetadataStore()
+        md.register_feature_group("txn_features", schema={"width": 64})
+        md.register_feature("amount", "txn_features")
+        md.register_feature("amount_log", "txn_features")
+        assert set(md.feature_names("txn_features")) == {"amount",
+                                                         "amount_log"}
+        assert md.stats()["feature_groups"] == 1
+
+
+class TestJsonSafety:
+    def test_categorical_only_stats_are_json_safe(self):
+        import json as _json
+
+        fs = FeatureStore()
+        fs.store_feature_values("u1", "user", {"payment_method": "card"},
+                                now=0.0)
+        s = fs.get_feature_statistics("payment_method")
+        assert s["min"] == 0.0 and s["max"] == 0.0
+        # strict JSON (no Infinity tokens)
+        _json.loads(_json.dumps(s))
