@@ -55,7 +55,6 @@ def main() -> None:
     )
 
     lat: dict[int, dict[str, float]] = {}
-    throughput = 0.0
     for bsz, iters in ((1, 200), (32, 100), (256, 50)):
         batch = make_example_batch(bsz, sc, rng=np.random.default_rng(bsz))
         out = fn(models, batch, params, model_valid)   # compile
@@ -71,12 +70,25 @@ def main() -> None:
             "p50_ms": float(np.percentile(times_ms, 50)),
             "p99_ms": float(np.percentile(times_ms, 99)),
         }
-        if bsz == 256:
-            throughput = bsz * len(times) / float(np.sum(times))
+
+    # Throughput: pipelined dispatch at batch 256 — JAX's async dispatch
+    # keeps the device fed while the host enqueues the next microbatch,
+    # exactly how the production path runs (stream/microbatch.py
+    # DoubleBufferedScorer). Per-dispatch round-trip latency (dominated by
+    # the axon tunnel here, ~45 ms) is reported separately above; blocking
+    # per batch would measure the tunnel, not the chip. The batch-256
+    # program and example batch are already compiled + warm from the
+    # latency loop's last iteration.
+    t0 = time.perf_counter()
+    outs = [fn(models, batch, params, model_valid) for _ in range(iters)]
+    jax.block_until_ready(outs)
+    pipelined_s = time.perf_counter() - t0
+    throughput = bsz * iters / pipelined_s
 
     baseline_tps = 15_000.0  # reference README.md:201 (whole cluster)
     print(json.dumps({
-        "metric": "full-ensemble scoring throughput (5 branches, batch=256)",
+        "metric": "full-ensemble scoring throughput (5 branches, batch=256, "
+                  "pipelined)",
         "value": round(throughput, 1),
         "unit": "txn/s/chip",
         "vs_baseline": round(throughput / baseline_tps, 3),

@@ -83,7 +83,8 @@ def cmd_run_job(args: argparse.Namespace) -> int:
     scorer = FraudScorer(scorer_config=ScorerConfig())
     scorer.seed_profiles(gen.users.profiles(), gen.merchants.profiles())
     job = StreamJob(broker, scorer, JobConfig(
-        max_batch=args.batch, enable_analytics=args.analytics))
+        max_batch=args.batch, enable_analytics=args.analytics,
+        enable_enrichment=args.enrichment))
 
     metadata: Optional[MetadataStore] = None
     ckpt: Optional[CheckpointManager] = None
@@ -284,6 +285,9 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--batch", type=int, default=256)
     sp.add_argument("--analytics", action="store_true",
                     help="attach the windowed-analytics stage")
+    sp.add_argument("--enrichment", action="store_true",
+                    help="blend the 6-category feature score into the "
+                         "enriched output (FeatureEnrichmentProcessor)")
     sp.add_argument("--checkpoint-dir", default="",
                     help="save params+state checkpoints per chunk")
     sp.add_argument("--metadata-db", default="",

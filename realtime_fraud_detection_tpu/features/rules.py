@@ -141,3 +141,91 @@ def risk_level_code(fraud_probability: jax.Array) -> jax.Array:
         + (fraud_probability >= 0.8)
         + (fraud_probability >= 0.95)
     ).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------- enrichment
+@jax.jit
+def enrichment_score(features: jax.Array) -> jax.Array:
+    """Category-weighted feature score over the 64-wide feature tensor
+    (FeatureEnrichmentProcessor.calculateFeatureBasedFraudScore,
+    FeatureEnrichmentProcessor.java:122-344): six category sub-scores
+    weighted .2/.1/.25/.2/.15/.1; only the weighted SUM is clipped to
+    [0, 1] (java :149) — individual categories are unbounded there too.
+
+    The reference builds this processor but never attaches it to the job
+    graph (SURVEY.md §0.3); here it runs vectorized on device and is wired
+    behind ``stream.JobConfig.enable_enrichment``.
+    """
+    from realtime_fraud_detection_tpu.features.extract import feature_index
+
+    f = features.astype(jnp.float32)
+
+    def col(name: str) -> jax.Array:
+        return f[:, feature_index(name)]
+
+    # amount (x0.2, :157-179)
+    amount_cat = col("amount_category")
+    amount = (
+        0.3 * (col("is_large_for_user") > 0)
+        + 0.1 * (col("is_round_100") > 0)
+        + jnp.where(amount_cat >= 4, 0.2,
+                    jnp.where(amount_cat < 1, 0.1, 0.0))  # very_large / micro
+    )
+    # temporal (x0.1, :184-206)
+    temporal = (
+        0.2 * (col("is_night_time") > 0)
+        + 0.15 * (col("in_user_preferred_time") <= 0)
+        + 0.1 * ((col("is_weekend") > 0)
+                 & (col("weekend_activity_factor") < 0.3))
+    )
+    # user behavior (x0.25, :211-238)
+    user = (
+        jnp.where(col("is_very_new_account") > 0, 0.4,
+                  jnp.where(col("is_new_account") > 0, 0.2, 0.0))
+        + 0.3 * (col("is_kyc_verified") <= 0)
+        + col("user_risk_score") * 0.5
+    )
+    # merchant risk (x0.2, :243-277)
+    merchant = (
+        0.8 * (col("is_blacklisted_merchant") > 0)
+        + 0.3 * (col("is_high_risk_category") > 0)
+        + col("merchant_fraud_rate") * 2.0
+        + 0.2 * (col("suspicious_merchant_name") > 0)
+        + 0.15 * (col("within_merchant_hours") <= 0)
+    )
+    # velocity (x0.15, :282-307)
+    velocity = (
+        0.6 * (col("high_velocity_5min") > 0)
+        + 0.4 * (col("high_velocity_1hour") > 0)
+        + 0.2 * (col("velocity_5min_count") > 3)
+        + 0.15 * (col("velocity_1hour_count") > 10)
+    )
+    # device / network (x0.1, :312-334)
+    device = (
+        0.3 * (col("is_new_device") > 0)
+        + col("ip_risk_score")
+        + 0.2 * (col("suspicious_user_agent") > 0)
+    )
+    score = (
+        amount * 0.2 + temporal * 0.1 + user * 0.25
+        + merchant * 0.2 + velocity * 0.15 + device * 0.1
+    )
+    return jnp.clip(score, 0.0, 1.0)
+
+
+@jax.jit
+def blend_enrichment(prior_score: jax.Array, features: jax.Array):
+    """60/40 blend of the prior score with the feature-based score, then
+    re-level (FeatureEnrichmentProcessor.java:84-90, 341-367). Returns
+    (blended f32[B], decision i32[B], risk_level i32[B]) where decision/
+    risk follow the enrichment ladder: >=0.95 DECLINE/CRITICAL, >=0.8
+    REVIEW/HIGH, >=0.6 REVIEW/MEDIUM, >=0.3 APPROVE/LOW, else
+    APPROVE/VERY_LOW."""
+    blended = jnp.clip(
+        prior_score * 0.6 + enrichment_score(features) * 0.4, 0.0, 1.0
+    )
+    decision = jnp.where(
+        blended >= 0.95, DECLINE,
+        jnp.where(blended >= 0.6, REVIEW, APPROVE),
+    ).astype(jnp.int32)
+    return blended, decision, risk_level_code(blended)

@@ -48,6 +48,10 @@ class JobConfig:
     # attach the windowed-analytics stage (the reference built its
     # WindowProcessor but never wired it into the job graph — SURVEY.md §0.3)
     enable_analytics: bool = False
+    # blend the 6-category feature score 60/40 into the enriched output
+    # (FeatureEnrichmentProcessor semantics — also built-but-unwired in the
+    # reference, FeatureEnrichmentProcessor.java:84-150)
+    enable_enrichment: bool = False
 
 
 class StreamJob:
@@ -122,6 +126,33 @@ class StreamJob:
                 for r in fresh
             ]
 
+        enriched_scores = None
+        wants_enriched = cfg.emit_enriched or self.analytics is not None
+        if cfg.enable_enrichment and scored_ok and wants_enriched:
+            import numpy as np
+
+            from realtime_fraud_detection_tpu.core.batching import (
+                pad_to_bucket,
+            )
+            from realtime_fraud_detection_tpu.features.rules import (
+                DECISIONS as _DECISIONS,
+                RISK_LEVEL_NAMES as _RISK,
+                blend_enrichment,
+            )
+
+            n = len(results)
+            prior = np.asarray([r["fraud_score"] for r in results], np.float32)
+            # pad to the scoring buckets so blend_enrichment compiles once
+            # per bucket, not once per tail-batch size
+            (prior_p, feats_p), _, _ = pad_to_bucket(
+                (prior, self.scorer.last_features[:n]), n)
+            blended, dec, risk = blend_enrichment(prior_p, feats_p)
+            enriched_scores = (
+                np.asarray(blended)[:n],
+                [_DECISIONS[i] for i in np.asarray(dec)[:n]],
+                [_RISK[i] for i in np.asarray(risk)[:n]],
+            )
+
         for i, (rec, res) in enumerate(zip(fresh, results)):
             uid = str(rec.value.get("user_id", ""))
             self.broker.produce(T.PREDICTIONS, res, key=uid)
@@ -135,6 +166,14 @@ class StreamJob:
                     risk_level=res["risk_level"],
                     decision=res["decision"],
                 )
+                if enriched_scores is not None:
+                    blended, decisions, risks = enriched_scores
+                    enriched.update(
+                        fraud_score=float(blended[i]),
+                        risk_level=risks[i],
+                        decision=decisions[i],
+                        ensemble_score=res["fraud_score"],
+                    )
                 if cfg.emit_enriched:
                     self.broker.produce(T.ENRICHED, enriched, key=uid)
                 if self.analytics is not None:

@@ -299,3 +299,97 @@ class TestReviewRegressions:
         day0 = gen.clock.day
         batch, _ = gen.generate_encoded(4)
         assert int(np.asarray(batch.day_of_month)[0]) == day0
+
+
+class TestEnrichment:
+    """FeatureEnrichmentProcessor semantics (java :84-150, 122-344)."""
+
+    @staticmethod
+    def _features(**overrides):
+        from realtime_fraud_detection_tpu.features.extract import (
+            NUM_FEATURES,
+            feature_index,
+        )
+
+        f = np.zeros((1, NUM_FEATURES), np.float32)
+        # defaults that zero out the "absence" penalties
+        f[0, feature_index("in_user_preferred_time")] = 1.0
+        f[0, feature_index("is_kyc_verified")] = 1.0
+        f[0, feature_index("within_merchant_hours")] = 1.0
+        f[0, feature_index("amount_category")] = 2.0
+        for name, v in overrides.items():
+            f[0, feature_index(name)] = v
+        return f
+
+    def test_zero_risk_features_score_zero(self):
+        from realtime_fraud_detection_tpu.features.rules import enrichment_score
+
+        assert float(np.asarray(enrichment_score(self._features()))[0]) == 0.0
+
+    def test_category_weights(self):
+        from realtime_fraud_detection_tpu.features.rules import enrichment_score
+
+        # blacklisted merchant alone: 0.8 * 0.2 category weight
+        s = enrichment_score(self._features(is_blacklisted_merchant=1.0))
+        assert float(np.asarray(s)[0]) == pytest.approx(0.8 * 0.2)
+        # high velocity 5min alone: 0.6 * 0.15
+        s = enrichment_score(self._features(high_velocity_5min=1.0))
+        assert float(np.asarray(s)[0]) == pytest.approx(0.6 * 0.15)
+        # very-new account + unverified: (0.4 + 0.3) * 0.25
+        s = enrichment_score(self._features(is_very_new_account=1.0,
+                                            is_kyc_verified=0.0))
+        assert float(np.asarray(s)[0]) == pytest.approx(0.7 * 0.25)
+
+    def test_blend_60_40_and_relevel(self):
+        from realtime_fraud_detection_tpu.features.rules import (
+            DECISIONS,
+            RISK_LEVEL_NAMES,
+            blend_enrichment,
+        )
+
+        f = self._features(is_blacklisted_merchant=1.0, high_velocity_5min=1.0,
+                           is_very_new_account=1.0, is_kyc_verified=0.0,
+                           user_risk_score=1.0, merchant_fraud_rate=0.3,
+                           is_high_risk_category=1.0, ip_risk_score=1.0,
+                           is_new_device=1.0, suspicious_user_agent=1.0,
+                           is_night_time=1.0, is_large_for_user=1.0)
+        prior = np.asarray([0.9], np.float32)
+        blended, dec, risk = blend_enrichment(prior, f)
+        b = float(np.asarray(blended)[0])
+        assert 0.6 * 0.9 < b <= 1.0
+        # enrichment ladder: >=0.6 -> REVIEW/MEDIUM+ (java :341-367)
+        assert DECISIONS[int(np.asarray(dec)[0])] in ("REVIEW", "DECLINE")
+        assert RISK_LEVEL_NAMES[int(np.asarray(risk)[0])] in (
+            "MEDIUM", "HIGH", "CRITICAL")
+
+    def test_job_wires_enrichment(self):
+        from realtime_fraud_detection_tpu.scoring import (
+            FraudScorer,
+            ScorerConfig,
+        )
+        from realtime_fraud_detection_tpu.sim.simulator import (
+            TransactionGenerator,
+        )
+        from realtime_fraud_detection_tpu.stream import (
+            InMemoryBroker,
+            JobConfig,
+            StreamJob,
+        )
+        from realtime_fraud_detection_tpu.stream import topics as T
+
+        gen = TransactionGenerator(num_users=20, num_merchants=10, seed=6)
+        broker = InMemoryBroker()
+        scorer = FraudScorer(scorer_config=ScorerConfig(text_len=32))
+        scorer.seed_profiles(gen.users.profiles(), gen.merchants.profiles())
+        job = StreamJob(broker, scorer,
+                        JobConfig(max_batch=32, enable_enrichment=True))
+        records = gen.generate_batch(40)
+        broker.produce_batch(T.TRANSACTIONS, records,
+                             key_fn=lambda r: str(r["user_id"]))
+        assert job.run_until_drained(now=1000.0) == 40
+        enriched = broker.consumer([T.ENRICHED], "c").poll(1000)
+        assert len(enriched) == 40
+        for r in enriched:
+            assert "ensemble_score" in r.value       # pre-blend score kept
+            assert 0.0 <= r.value["fraud_score"] <= 1.0
+            assert r.value["decision"] in ("APPROVE", "REVIEW", "DECLINE")

@@ -143,3 +143,25 @@ def test_stream_job_replay_dedupe(job_env):
     job.run_until_drained(now=2001.0)
     assert job.counters["scored"] == before
     assert job.counters["duplicates_skipped"] == 10
+
+
+def test_enrichment_applies_with_analytics_only(job_env):
+    """enable_enrichment must still blend when emit_enriched=False but the
+    analytics stage consumes the enriched dicts."""
+    from realtime_fraud_detection_tpu.scoring import FraudScorer, ScorerConfig
+    from realtime_fraud_detection_tpu.sim.simulator import TransactionGenerator
+
+    gen = TransactionGenerator(num_users=15, num_merchants=8, seed=13)
+    broker = InMemoryBroker()
+    scorer = FraudScorer(scorer_config=ScorerConfig(text_len=32))
+    scorer.seed_profiles(gen.users.profiles(), gen.merchants.profiles())
+    job = StreamJob(broker, scorer, JobConfig(
+        max_batch=16, emit_enriched=False, enable_analytics=True,
+        enable_enrichment=True))
+    records = gen.generate_batch(20)
+    broker.produce_batch(T.TRANSACTIONS, records,
+                         key_fn=lambda r: str(r["user_id"]))
+    assert job.run_until_drained(now=1000.0) == 20
+    # nothing on the enriched topic, but analytics saw blended scores
+    assert not broker.consumer([T.ENRICHED], "c").poll(100)
+    assert job.analytics.stats()["user_velocity"]["watermark"] > 0
