@@ -55,8 +55,10 @@ def main() -> None:
     )
 
     lat: dict[int, dict[str, float]] = {}
+    batches: dict[int, object] = {}
     for bsz, iters in ((1, 200), (32, 100), (256, 50)):
         batch = make_example_batch(bsz, sc, rng=np.random.default_rng(bsz))
+        batches[bsz] = batch
         out = fn(models, batch, params, model_valid)   # compile
         jax.block_until_ready(out)
         times = []
@@ -78,7 +80,9 @@ def main() -> None:
     # the axon tunnel here, ~45 ms) is reported separately above; blocking
     # per batch would measure the tunnel, not the chip. The batch-256
     # program and example batch are already compiled + warm from the
-    # latency loop's last iteration.
+    # latency sweep (selected explicitly — no reliance on loop ordering).
+    bsz, iters = 256, 50
+    batch = batches[bsz]
     t0 = time.perf_counter()
     outs = [fn(models, batch, params, model_valid) for _ in range(iters)]
     jax.block_until_ready(outs)

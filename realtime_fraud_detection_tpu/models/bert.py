@@ -108,8 +108,16 @@ def bert_encode(
     config: BertConfig,
     use_pallas: bool = False,
     compute_dtype=jnp.bfloat16,
+    attention_fn=None,
 ) -> jax.Array:
-    """Hidden states f32[B, S, H]."""
+    """Hidden states f32[B, S, H].
+
+    ``attention_fn(q, k, v, key_mask) -> ctx`` overrides the attention
+    implementation — the hook context parallelism plugs into
+    (``parallel.context.bert_context_parallel_predict`` passes ring
+    attention here; everything else in the layer is per-token and shards
+    along S for free).
+    """
     b, s = input_ids.shape
     x = params["word_emb"][input_ids] + params["pos_emb"][:s][None, :, :]
     x = _layer_norm(x, params["emb_ln"], config.layer_norm_eps)
@@ -123,7 +131,9 @@ def bert_encode(
             return t.reshape(b, s, config.num_heads, config.head_dim).transpose(0, 2, 1, 3)
 
         qh, kh, vh = split(q), split(k), split(v)
-        if use_pallas:
+        if attention_fn is not None:
+            ctx = attention_fn(qh, kh, vh, attention_mask)
+        elif use_pallas:
             ctx = flash_attention(qh, kh, vh, attention_mask)
         else:
             ctx = attention_reference(qh, kh, vh, attention_mask)
@@ -143,9 +153,11 @@ def bert_logits(
     attention_mask: jax.Array,
     config: BertConfig,
     use_pallas: bool = False,
+    attention_fn=None,
 ) -> jax.Array:
     """Sequence-classification logits f32[B, num_labels] from [CLS]."""
-    hidden = bert_encode(params, input_ids, attention_mask, config, use_pallas)
+    hidden = bert_encode(params, input_ids, attention_mask, config,
+                         use_pallas, attention_fn=attention_fn)
     cls = hidden[:, 0, :]
     z = jax.nn.relu(cls @ params["pre_classifier"]["w"] + params["pre_classifier"]["b"])
     return z @ params["classifier"]["w"] + params["classifier"]["b"]
@@ -157,8 +169,10 @@ def bert_predict(
     attention_mask: jax.Array,
     config: BertConfig,
     use_pallas: bool = False,
+    attention_fn=None,
 ) -> jax.Array:
     """Fraud probability f32[B] = softmax(logits)[:, 1]
     (bert_text_analyzer.py:216-222)."""
-    logits = bert_logits(params, input_ids, attention_mask, config, use_pallas)
+    logits = bert_logits(params, input_ids, attention_mask, config,
+                         use_pallas, attention_fn=attention_fn)
     return jax.nn.softmax(logits, axis=-1)[:, 1]

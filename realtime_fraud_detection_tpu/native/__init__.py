@@ -24,21 +24,27 @@ _lib: Optional[ctypes.CDLL] = None
 _build_error: Optional[str] = None
 
 
-def _build() -> Optional[ctypes.CDLL]:
-    global _build_error
+def _compile_native(src: Path, lib_path: Path) -> tuple[Optional[ctypes.CDLL], Optional[str]]:
+    """Shared on-demand g++ build: env-var gate, mtime cache, one compiler
+    recipe for every native kernel in this package. Returns (lib, error)."""
     if os.environ.get("RTFD_DISABLE_NATIVE") == "1":
-        _build_error = "disabled via RTFD_DISABLE_NATIVE"
-        return None
+        return None, "disabled via RTFD_DISABLE_NATIVE"
     try:
-        if not _LIB.exists() or _LIB.stat().st_mtime < _SRC.stat().st_mtime:
+        if not lib_path.exists() or lib_path.stat().st_mtime < src.stat().st_mtime:
             cmd = [
                 "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
-                str(_SRC), "-o", str(_LIB),
+                str(src), "-o", str(lib_path),
             ]
             subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        lib = ctypes.CDLL(str(_LIB))
+        return ctypes.CDLL(str(lib_path)), None
     except (OSError, subprocess.SubprocessError) as e:
-        _build_error = str(e)
+        return None, str(e)
+
+
+def _build() -> Optional[ctypes.CDLL]:
+    global _build_error
+    lib, _build_error = _compile_native(_SRC, _LIB)
+    if lib is None:
         return None
 
     lib.mb_create.restype = ctypes.c_void_p
@@ -152,3 +158,93 @@ class NativeMicrobatchQueue:
             self.close()
         except Exception:
             pass
+
+
+# ------------------------------------------------------------------- trees
+_TREES_SRC = _DIR / "trees.cpp"
+_TREES_LIB = _DIR / "_trees.so"
+_trees_lib: Optional[ctypes.CDLL] = None
+_trees_error: Optional[str] = None
+
+
+def _build_trees() -> Optional[ctypes.CDLL]:
+    global _trees_error
+    lib, _trees_error = _compile_native(_TREES_SRC, _TREES_LIB)
+    if lib is None:
+        return None
+    import numpy as np
+    from numpy.ctypeslib import ndpointer
+
+    lib.trees_score_mt.restype = None
+    lib.trees_score_mt.argtypes = [
+        ndpointer(np.int32, flags="C_CONTIGUOUS"),
+        ndpointer(np.float32, flags="C_CONTIGUOUS"),
+        ndpointer(np.float32, flags="C_CONTIGUOUS"),
+        ctypes.c_float, ctypes.c_int32, ctypes.c_int32,
+        ndpointer(np.float32, flags="C_CONTIGUOUS"),
+        ctypes.c_int32, ctypes.c_int32,
+        ndpointer(np.float32, flags="C_CONTIGUOUS"),
+        ctypes.c_int32,
+    ]
+    return lib
+
+
+def _get_trees_lib() -> Optional[ctypes.CDLL]:
+    global _trees_lib
+    with _lock:
+        if _trees_lib is None and _trees_error is None:
+            _trees_lib = _build_trees()
+        return _trees_lib
+
+
+def native_trees_available() -> bool:
+    return _get_trees_lib() is not None
+
+
+class NativeTreeScorer:
+    """C++ boosted-tree inference over the framework's complete-binary-tree
+    layout (models/trees.py TreeEnsemble) — the CPU-baseline scorer twin of
+    the TPU tensorized traversal (SURVEY.md §2.9 component 2) and an
+    independent numerics oracle for it.
+    """
+
+    def __init__(self, ensemble, n_threads: int = 0):
+        import numpy as np
+
+        lib = _get_trees_lib()
+        if lib is None:
+            raise RuntimeError(f"native tree scorer unavailable: {_trees_error}")
+        self._lib = lib
+        self.feature = np.ascontiguousarray(
+            np.asarray(ensemble.feature), np.int32)
+        self.threshold = np.ascontiguousarray(
+            np.asarray(ensemble.threshold), np.float32)
+        self.leaf = np.ascontiguousarray(np.asarray(ensemble.leaf), np.float32)
+        self.base_score = float(np.asarray(ensemble.base_score))
+        self.n_trees = self.feature.shape[0]
+        self.depth = int(self.leaf.shape[1]).bit_length() - 1
+        self.n_threads = n_threads or min(8, os.cpu_count() or 1)
+        # widest feature index any split touches: inputs narrower than this
+        # would make the C++ kernel read out of bounds
+        self.min_features = int(self.feature.max()) + 1 if self.n_trees else 0
+
+    def logits(self, x):
+        import numpy as np
+
+        x = np.ascontiguousarray(np.asarray(x), np.float32)
+        if x.ndim != 2 or x.shape[1] < self.min_features:
+            raise ValueError(
+                f"need f32[B, >= {self.min_features}] features, got {x.shape}")
+        out = np.empty((x.shape[0],), np.float32)
+        self._lib.trees_score_mt(
+            self.feature, self.threshold, self.leaf, self.base_score,
+            self.n_trees, self.depth, x, x.shape[0], x.shape[1], out,
+            self.n_threads)
+        return out
+
+    def predict(self, x):
+        """Fraud probability: sigmoid(logits), matching
+        models.trees.tree_ensemble_predict."""
+        import numpy as np
+
+        return 1.0 / (1.0 + np.exp(-self.logits(x)))

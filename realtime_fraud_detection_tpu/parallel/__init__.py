@@ -6,6 +6,7 @@ shardings that XLA lowers to collectives.
 """
 
 from realtime_fraud_detection_tpu.parallel.context import (  # noqa: F401
+    bert_context_parallel_predict,
     ring_attention,
 )
 from realtime_fraud_detection_tpu.parallel.layouts import (  # noqa: F401

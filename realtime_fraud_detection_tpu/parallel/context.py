@@ -103,3 +103,40 @@ def ring_attention(
         out_specs=qkv_spec,
     )
     return fn(q, k, v, key_mask)
+
+
+def bert_context_parallel_predict(
+    mesh: Mesh,
+    params,
+    input_ids: jax.Array,       # i32[B, S]
+    attention_mask: jax.Array,  # bool[B, S]
+    config,
+) -> jax.Array:
+    """Long-context text-branch forward with the sequence dim sharded over
+    the ``seq`` mesh axis.
+
+    Attention runs as ring attention; every other op in the encoder
+    (embeddings, layernorm, FFN matmuls, residuals) is per-token, so with
+    the activations laid out P(data, seq, ...) XLA partitions them along S
+    with no further annotation. Only the [CLS] pooling gathers across
+    shards at the end. Numerics match the single-device encoder.
+
+    At the reference's 512-token ceiling this is optional; it is the
+    scaling path for long-context work (SURVEY.md §5.7).
+    """
+    from jax.sharding import NamedSharding
+
+    from realtime_fraud_detection_tpu.models.bert import bert_predict
+
+    ids = jax.device_put(input_ids, NamedSharding(mesh, P(DATA_AXIS, SEQ_AXIS)))
+    mask = jax.device_put(
+        attention_mask, NamedSharding(mesh, P(DATA_AXIS, SEQ_AXIS)))
+    # replicate params onto THIS mesh: arrays restored from checkpoint (or
+    # any earlier device_put) arrive committed to one device and would
+    # clash with the mesh-sharded activations (same hazard FraudScorer.
+    # set_models handles)
+    params = jax.device_put(params, NamedSharding(mesh, P()))
+    return bert_predict(
+        params, ids, mask, config,
+        attention_fn=lambda q, k, v, m: ring_attention(mesh, q, k, v, m),
+    )

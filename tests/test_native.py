@@ -122,3 +122,74 @@ def test_tsan_stress(tmp_path):
                          timeout=120)
     assert run.returncode == 0, run.stdout + run.stderr
     assert run.stdout.startswith("OK")
+
+
+@pytest.mark.skipif(shutil.which("g++") is None, reason="no C++ toolchain")
+class TestNativeTreeScorer:
+    """C++ tree kernel vs the JAX tensorized traversal (same layout)."""
+
+    @pytest.fixture(scope="class")
+    def trained(self):
+        import numpy as np
+
+        from realtime_fraud_detection_tpu.training import GBDTTrainer
+
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((2000, 64)).astype(np.float32)
+        y = (x[:, 3] + 0.5 * x[:, 17] > 0.7).astype(np.float32)
+        ens = GBDTTrainer(n_estimators=20, max_depth=4, seed=1).fit(x, y)
+        return ens, x
+
+    def test_matches_jax_kernel(self, trained):
+        import numpy as np
+
+        from realtime_fraud_detection_tpu.models.trees import (
+            tree_ensemble_logits,
+        )
+        from realtime_fraud_detection_tpu.native import (
+            NativeTreeScorer,
+            native_trees_available,
+        )
+
+        if not native_trees_available():
+            pytest.skip("native build failed")
+        ens, x = trained
+        scorer = NativeTreeScorer(ens)
+        got = scorer.logits(x)
+        expect = np.asarray(tree_ensemble_logits(ens, x))
+        np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-5)
+
+    def test_predict_is_sigmoid_and_threaded_matches(self, trained):
+        import numpy as np
+
+        from realtime_fraud_detection_tpu.native import (
+            NativeTreeScorer,
+            native_trees_available,
+        )
+
+        if not native_trees_available():
+            pytest.skip("native build failed")
+        ens, x = trained
+        st = NativeTreeScorer(ens, n_threads=1)
+        mt = NativeTreeScorer(ens, n_threads=4)
+        np.testing.assert_allclose(st.logits(x), mt.logits(x))
+        p = st.predict(x[:8])
+        np.testing.assert_allclose(
+            p, 1.0 / (1.0 + np.exp(-st.logits(x[:8]))), rtol=1e-6)
+        assert ((p >= 0) & (p <= 1)).all()
+
+    def test_rejects_too_narrow_input(self, trained):
+        import numpy as np
+
+        from realtime_fraud_detection_tpu.native import (
+            NativeTreeScorer,
+            native_trees_available,
+        )
+
+        if not native_trees_available():
+            pytest.skip("native build failed")
+        ens, _ = trained
+        scorer = NativeTreeScorer(ens)
+        narrow = np.zeros((4, scorer.min_features - 1), np.float32)
+        with pytest.raises(ValueError, match="features"):
+            scorer.logits(narrow)

@@ -157,3 +157,28 @@ class TestRingAttention:
                                 np.asarray(vb, np.float32), mask))
         np.testing.assert_allclose(
             np.asarray(got, np.float32), expect, rtol=0.1, atol=0.1)
+
+
+def test_bert_context_parallel_matches_single_device():
+    """CP encoder (seq sharded + ring attention) must match the plain
+    encoder: all non-attention ops are per-token, attention is exact."""
+    from realtime_fraud_detection_tpu.models.bert import (
+        TINY_CONFIG,
+        bert_predict,
+        init_bert_params,
+    )
+    from realtime_fraud_detection_tpu.parallel import (
+        bert_context_parallel_predict,
+    )
+
+    mesh = build_mesh(MeshConfig(data=2, seq=4))
+    params = init_bert_params(jax.random.PRNGKey(1), TINY_CONFIG)
+    rng = np.random.default_rng(3)
+    ids = rng.integers(0, TINY_CONFIG.vocab_size, (4, 32)).astype(np.int32)
+    mask = np.ones((4, 32), bool)
+    mask[:, 28:] = False
+
+    expect = np.asarray(bert_predict(params, ids, mask, TINY_CONFIG))
+    got = np.asarray(bert_context_parallel_predict(
+        mesh, params, ids, mask, TINY_CONFIG))
+    np.testing.assert_allclose(got, expect, rtol=2e-4, atol=2e-4)
