@@ -149,6 +149,97 @@ class CheckpointManager:
             shutil.rmtree(self._step_dir(s), ignore_errors=True)
 
     # -------------------------------------------------------------- restore
+    def manifest(self, step: Optional[int] = None) -> Dict[str, Any]:
+        """Read a checkpoint's manifest without restoring params."""
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(
+                    f"no checkpoints under {self.directory}")
+        with open(self._step_dir(step) / _MANIFEST) as f:
+            return json.load(f)
+
+    def scoring_models_template(self, step: Optional[int] = None,
+                                bert_config=None, feature_dim: int = 64,
+                                node_dim: int = 16):
+        """Restore template for a ScoringModels checkpoint.
+
+        Tree/isolation-forest shapes vary with training flags (``train
+        --trees N``); savers record them under metadata.model_shapes and
+        this rebuilds a template with matching shapes so orbax's typed
+        restore succeeds regardless of the trained sizes. When the manifest
+        also records bert/feature dims, a mismatch with the requested dims
+        raises a clear error instead of a cryptic orbax shape failure.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        from realtime_fraud_detection_tpu.models.bert import TINY_CONFIG
+        from realtime_fraud_detection_tpu.models.isolation_forest import (
+            IsolationForest,
+        )
+        from realtime_fraud_detection_tpu.scoring import init_scoring_models
+
+        meta = self.manifest(step).get("metadata") or {}
+        shapes = meta.get("model_shapes") or {}
+        want = {
+            "bert_hidden": None if bert_config is None
+            else bert_config.hidden_size,
+            "bert_layers": None if bert_config is None
+            else bert_config.num_layers,
+            "feature_dim": feature_dim,
+            "node_dim": node_dim,
+        }
+        for key, expected in want.items():
+            recorded = shapes.get(key)
+            if (recorded is not None and expected is not None
+                    and int(recorded) != int(expected)):
+                raise ValueError(
+                    f"checkpoint {key}={recorded} does not match the "
+                    f"server's {key}={expected}; restore with a matching "
+                    f"config")
+        n_trees, tree_depth = shapes.get("trees", (100, 6))
+        models = init_scoring_models(
+            jax.random.PRNGKey(0),
+            bert_config=bert_config if bert_config is not None else TINY_CONFIG,
+            feature_dim=feature_dim, node_dim=node_dim,
+            n_trees=int(n_trees), tree_depth=int(tree_depth))
+        if "iforest" in shapes:
+            n_if, if_depth = (int(v) for v in shapes["iforest"])
+            models = models.replace(iforest=IsolationForest(
+                feature=jnp.zeros((n_if, 2 ** if_depth - 1), jnp.int32),
+                threshold=jnp.zeros((n_if, 2 ** if_depth - 1), jnp.float32),
+                path_length=jnp.zeros((n_if, 2 ** if_depth), jnp.float32),
+                c_psi=jnp.asarray(0.0, jnp.float32),
+            ))
+        return models
+
+    def restore_into_scorer(self, scorer, step: Optional[int] = None,
+                            lock=None) -> Checkpoint:
+        """Restore params + host state into a FraudScorer (one recipe for
+        both the CLI's ``serve --checkpoint-dir`` and the serving app's
+        ``/reload-models``). The step is resolved ONCE so the template and
+        the restore always read the same checkpoint even while a trainer
+        writes new steps; ``lock`` (the serving score lock) makes the swap
+        atomic w.r.t. in-flight scoring."""
+        import contextlib
+
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(
+                    f"no checkpoints under {self.directory}")
+        template = self.scoring_models_template(
+            step=step, bert_config=scorer.bert_config,
+            feature_dim=scorer.sc.feature_dim, node_dim=scorer.sc.node_dim)
+        ck = self.restore(step=step, params_template=template)
+        with (lock if lock is not None else contextlib.nullcontext()):
+            if ck.params is not None:
+                scorer.set_models(ck.params)
+            if ck.host_state is not None:
+                restore_scorer_host_state(scorer, ck.host_state)
+        return ck
+
     def restore(self, step: Optional[int] = None,
                 params_template: Any = None) -> Checkpoint:
         """Load a checkpoint (latest if ``step`` is None).

@@ -153,6 +153,13 @@ def cmd_serve(args: argparse.Namespace) -> int:
     if args.port is not None:
         config.serving.port = args.port
     app = ServingApp(config=config)
+    if args.checkpoint_dir:
+        from realtime_fraud_detection_tpu.checkpoint import CheckpointManager
+
+        ck = CheckpointManager(args.checkpoint_dir).restore_into_scorer(
+            app.scorer)
+        print(f"restored checkpoint step {ck.step} from "
+              f"{args.checkpoint_dir}", file=sys.stderr)
     print(f"serving on {config.serving.host}:{config.serving.port}",
           file=sys.stderr)
     app.run_forever()
@@ -162,7 +169,10 @@ def cmd_serve(args: argparse.Namespace) -> int:
 def cmd_train(args: argparse.Namespace) -> int:
     """Train the tree models on synthetic data and save a checkpoint
     (model_trainer.py analog: XGBoost + IsolationForest, AUC eval,
-    artifact save — :41-295)."""
+    artifact save — :41-295). The checkpoint holds a FULL ScoringModels
+    set (trained trees + isolation forest, fresh neural branches) so
+    ``serve --checkpoint-dir`` and ``POST /reload-models`` can load it
+    directly."""
     import numpy as np
 
     from realtime_fraud_detection_tpu.checkpoint import CheckpointManager
@@ -190,10 +200,31 @@ def cmd_train(args: argparse.Namespace) -> int:
     iforest = IsolationForestTrainer(seed=args.seed).fit(
         x[:split][y[:split] == 0])          # fit on normals only (:235-276)
 
+    import jax
+
+    from realtime_fraud_detection_tpu.scoring import init_scoring_models
+
+    models = init_scoring_models(jax.random.PRNGKey(args.seed))
+    models = models.replace(trees=trees, iforest=iforest)
+
     mgr = CheckpointManager(args.out)
-    path = mgr.save(0, params={"trees": trees, "iforest": iforest},
+    path = mgr.save(0, params=models,
                     metadata={"rows": args.rows, "auc": auc,
-                              "fraud_rate": float(y.mean())})
+                              "fraud_rate": float(y.mean()),
+                              "model_shapes": {
+                                  "trees": [trees.n_trees, trees.depth],
+                                  "iforest": [
+                                      iforest.n_trees,
+                                      int(iforest.path_length.shape[1]
+                                          ).bit_length() - 1,
+                                  ],
+                                  # restore-compatibility guard dims
+                                  "bert_hidden":
+                                      models.bert["word_emb"].shape[1],
+                                  "bert_layers": len(models.bert["layers"]),
+                                  "feature_dim": 64,
+                                  "node_dim": 16,
+                              }})
     print(json.dumps({"auc": round(auc, 4),
                       "fraud_rate": round(float(y.mean()), 4),
                       "checkpoint": str(path)}))
@@ -298,6 +329,8 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--host", default="")
     sp.add_argument("--port", type=int, default=None)
     sp.add_argument("--config", default="", help="JSON config file")
+    sp.add_argument("--checkpoint-dir", default="",
+                    help="restore model params (e.g. from `train`) at startup")
     sp.set_defaults(fn=cmd_serve)
 
     sp = sub.add_parser("train", help="train tree models on synthetic data")

@@ -105,6 +105,19 @@ def ring_attention(
     return fn(q, k, v, key_mask)
 
 
+import functools
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "config"))
+def _cp_bert_forward(params, ids, mask, mesh, config):
+    from realtime_fraud_detection_tpu.models.bert import bert_predict
+
+    return bert_predict(
+        params, ids, mask, config,
+        attention_fn=lambda q, k, v, m: ring_attention(mesh, q, k, v, m),
+    )
+
+
 def bert_context_parallel_predict(
     mesh: Mesh,
     params,
@@ -126,17 +139,14 @@ def bert_context_parallel_predict(
     """
     from jax.sharding import NamedSharding
 
-    from realtime_fraud_detection_tpu.models.bert import bert_predict
-
     ids = jax.device_put(input_ids, NamedSharding(mesh, P(DATA_AXIS, SEQ_AXIS)))
     mask = jax.device_put(
         attention_mask, NamedSharding(mesh, P(DATA_AXIS, SEQ_AXIS)))
     # replicate params onto THIS mesh: arrays restored from checkpoint (or
     # any earlier device_put) arrive committed to one device and would
     # clash with the mesh-sharded activations (same hazard FraudScorer.
-    # set_models handles)
+    # set_models handles). No-op when already replicated, so repeated calls
+    # don't re-copy; the forward itself is jitted (mesh/config static) so
+    # layers trace once per (mesh, config, shapes).
     params = jax.device_put(params, NamedSharding(mesh, P()))
-    return bert_predict(
-        params, ids, mask, config,
-        attention_fn=lambda q, k, v, m: ring_attention(mesh, q, k, v, m),
-    )
+    return _cp_bert_forward(params, ids, mask, mesh, config)

@@ -30,10 +30,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
-from realtime_fraud_detection_tpu.checkpoint import (
-    CheckpointManager,
-    restore_scorer_host_state,
-)
+from realtime_fraud_detection_tpu.checkpoint import CheckpointManager
 from realtime_fraud_detection_tpu.obs import (
     DriftConfig,
     FeatureDriftMonitor,
@@ -221,31 +218,18 @@ class ServingApp:
                                              f"got {step!r}")
 
                 def _restore():
+                    # one shared recipe (checkpoint.restore_into_scorer):
+                    # step resolved once, shape-aware template from the
+                    # manifest, swap under the score lock
                     mgr = CheckpointManager(body["checkpoint_dir"])
-                    import jax
-
-                    template = init_scoring_models(
-                        jax.random.PRNGKey(0),
-                        bert_config=self.scorer.bert_config,
-                        feature_dim=self.scorer.sc.feature_dim,
-                        node_dim=self.scorer.sc.node_dim)
-                    ck = mgr.restore(step=step, params_template=template)
-                    return ck
+                    return mgr.restore_into_scorer(
+                        self.scorer, step=step, lock=self._score_lock)
                 try:
                     ck = await loop.run_in_executor(None, _restore)
                 except FileNotFoundError as e:
                     raise HttpError(404, str(e))
-
-                def _swap():
-                    # _score_lock keeps the swap atomic w.r.t. an in-flight
-                    # score_batch in the batcher/executor threads (graph and
-                    # entity-index state must change together)
-                    with self._score_lock:
-                        if ck.params is not None:
-                            self.scorer.set_models(ck.params)
-                        if ck.host_state is not None:
-                            restore_scorer_host_state(self.scorer, ck.host_state)
-                await loop.run_in_executor(None, _swap)
+                except ValueError as e:
+                    raise HttpError(409, str(e))   # config/shape mismatch
                 source = {"checkpoint": body["checkpoint_dir"],
                           "step": ck.step}
             else:
