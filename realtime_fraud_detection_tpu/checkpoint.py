@@ -70,6 +70,34 @@ def _close_checkpointer() -> None:
         _CHECKPOINTER = None
 
 
+def _derive_model_shapes(params: Any) -> Optional[Dict[str, Any]]:
+    """Auto-derive restore-template shapes from a ScoringModels pytree.
+
+    Recorded on EVERY save that stores a ScoringModels (train, run-job,
+    serving), so restore never has to guess shapes from init defaults."""
+    import numpy as np
+
+    required = ("trees", "iforest", "lstm", "gnn", "bert")
+    if not all(hasattr(params, k) for k in required):
+        return None
+    try:
+        lstm_hidden = int(np.shape(params.lstm["b_gates"])[0]) // 4
+        return {
+            "trees": [int(params.trees.n_trees), int(params.trees.depth)],
+            "iforest": [
+                int(np.shape(params.iforest.feature)[0]),
+                int(np.shape(params.iforest.path_length)[1]).bit_length() - 1,
+            ],
+            "bert_hidden": int(np.shape(params.bert["word_emb"])[1]),
+            "bert_layers": len(params.bert["layers"]),
+            "feature_dim": int(np.shape(params.lstm["w_gates"])[0])
+            - lstm_hidden,
+            "node_dim": int(np.shape(params.gnn["w_sage1"])[0]) // 2,
+        }
+    except (KeyError, TypeError, IndexError, AttributeError):
+        return None
+
+
 @dataclasses.dataclass
 class Checkpoint:
     step: int
@@ -130,13 +158,18 @@ class CheckpointManager:
         if host_state is not None:
             with open(d / _HOST_STATE, "wb") as f:
                 pickle.dump(host_state, f, protocol=pickle.HIGHEST_PROTOCOL)
+        meta = dict(metadata) if metadata is not None else {}
+        if params is not None and "model_shapes" not in meta:
+            shapes = _derive_model_shapes(params)
+            if shapes is not None:
+                meta["model_shapes"] = shapes
         manifest = {
             "step": step,
             "wall_time": time.time(),
             "has_params": params is not None,
             "has_host_state": host_state is not None,
             "offsets": dict(offsets) if offsets is not None else None,
-            "metadata": dict(metadata) if metadata is not None else None,
+            "metadata": meta or None,
         }
         with open(d / _MANIFEST, "w") as f:
             json.dump(manifest, f, indent=1)

@@ -207,6 +207,27 @@ def cmd_train(args: argparse.Namespace) -> int:
     models = init_scoring_models(jax.random.PRNGKey(args.seed))
     models = models.replace(trees=trees, iforest=iforest)
 
+    if args.neural:
+        # train every neural branch too (the reference's ModelTrainer
+        # docstring claims LSTM/BERT/GNN trainers that don't exist —
+        # model_trainer.py:2-4, SURVEY.md §3.5)
+        from realtime_fraud_detection_tpu.models.bert import TINY_CONFIG
+        from realtime_fraud_detection_tpu.training.neural import (
+            train_gnn,
+            train_lstm,
+        )
+        from realtime_fraud_detection_tpu.training.text import train_bert
+
+        n = args.rows
+        lstm = train_lstm(gen, n_transactions=n, hidden=128,
+                          epochs=2, seed=args.seed)
+        gnn, _, _, _ = train_gnn(gen, n_transactions=n, node_dim=16,
+                                 hidden=64, epochs=2, seed=args.seed)
+        bert = train_bert(gen, config=TINY_CONFIG,
+                          n_transactions=min(n, 8000), epochs=1,
+                          seed=args.seed)
+        models = models.replace(lstm=lstm, gnn=gnn, bert=bert)
+
     mgr = CheckpointManager(args.out)
     path = mgr.save(0, params=models,
                     metadata={"rows": args.rows, "auc": auc,
@@ -227,6 +248,7 @@ def cmd_train(args: argparse.Namespace) -> int:
                               }})
     print(json.dumps({"auc": round(auc, 4),
                       "fraud_rate": round(float(y.mean()), 4),
+                      "neural_trained": bool(args.neural),
                       "checkpoint": str(path)}))
     return 0
 
@@ -338,6 +360,8 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--rows", type=int, default=10_000,
                     help="synthetic rows (model_trainer.py:123)")
     sp.add_argument("--trees", type=int, default=100)
+    sp.add_argument("--neural", action="store_true",
+                    help="also train the LSTM/GNN/BERT branches")
     sp.add_argument("--out", default="./checkpoints")
     sp.set_defaults(fn=cmd_train)
 
