@@ -158,11 +158,13 @@ class CheckpointManager:
         if host_state is not None:
             with open(d / _HOST_STATE, "wb") as f:
                 pickle.dump(host_state, f, protocol=pickle.HIGHEST_PROTOCOL)
+        # model_shapes is a *derived* manifest field, kept out of the
+        # caller's metadata so metadata round-trips verbatim (a caller that
+        # recorded shapes itself under metadata wins, for old callers).
         meta = dict(metadata) if metadata is not None else {}
-        if params is not None and "model_shapes" not in meta:
+        shapes = meta.get("model_shapes")
+        if params is not None and shapes is None:
             shapes = _derive_model_shapes(params)
-            if shapes is not None:
-                meta["model_shapes"] = shapes
         manifest = {
             "step": step,
             "wall_time": time.time(),
@@ -170,6 +172,7 @@ class CheckpointManager:
             "has_host_state": host_state is not None,
             "offsets": dict(offsets) if offsets is not None else None,
             "metadata": meta or None,
+            "model_shapes": shapes,
         }
         with open(d / _MANIFEST, "w") as f:
             json.dump(manifest, f, indent=1)
@@ -198,11 +201,13 @@ class CheckpointManager:
         """Restore template for a ScoringModels checkpoint.
 
         Tree/isolation-forest shapes vary with training flags (``train
-        --trees N``); savers record them under metadata.model_shapes and
-        this rebuilds a template with matching shapes so orbax's typed
-        restore succeeds regardless of the trained sizes. When the manifest
-        also records bert/feature dims, a mismatch with the requested dims
-        raises a clear error instead of a cryptic orbax shape failure.
+        --trees N``); ``save`` records them in the manifest's top-level
+        ``model_shapes`` field (older checkpoints carried them inside
+        metadata) and this rebuilds a template with matching shapes so
+        orbax's typed restore succeeds regardless of the trained sizes.
+        When the manifest also records bert/feature dims, a mismatch with
+        the requested dims raises a clear error instead of a cryptic orbax
+        shape failure.
         """
         import jax
         import jax.numpy as jnp
@@ -213,8 +218,9 @@ class CheckpointManager:
         )
         from realtime_fraud_detection_tpu.scoring import init_scoring_models
 
-        meta = self.manifest(step).get("metadata") or {}
-        shapes = meta.get("model_shapes") or {}
+        manifest = self.manifest(step)
+        meta = manifest.get("metadata") or {}
+        shapes = manifest.get("model_shapes") or meta.get("model_shapes") or {}
         want = {
             "bert_hidden": None if bert_config is None
             else bert_config.hidden_size,

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 from typing import Any, Dict, Optional
@@ -43,6 +44,32 @@ def cmd_simulate(args: argparse.Namespace) -> int:
     gen = TransactionGenerator(num_users=args.users,
                                num_merchants=args.merchants,
                                seed=args.seed, tps=args.tps)
+    if getattr(args, "broker", ""):
+        # produce into an external broker at ~tps (start-simulation.sh role)
+        from realtime_fraud_detection_tpu.stream import NetBrokerClient
+        from realtime_fraud_detection_tpu.stream import topics as T
+
+        host, port = _addr(args.broker, 9092)
+        client = NetBrokerClient(host=host, port=port)
+        n_fraud = produced = 0
+        try:
+            while produced < args.count:
+                chunk = min(1000, args.count - produced,
+                            max(1, int(args.tps)))
+                t0 = time.perf_counter()
+                records = gen.generate_batch(chunk)
+                n_fraud += sum(bool(t.get("is_fraud")) for t in records)
+                client.produce_batch(T.TRANSACTIONS, records,
+                                     key_fn=lambda r: str(r["user_id"]))
+                produced += chunk
+                budget = chunk / args.tps - (time.perf_counter() - t0)
+                if budget > 0:
+                    time.sleep(budget)
+        finally:
+            client.close()
+        print(f"produced {produced} txns ({n_fraud} fraud) to "
+              f"{args.broker}", file=sys.stderr)
+        return 0
     out = sys.stdout if args.output == "-" else open(args.output, "w")
     try:
         n_fraud = 0
@@ -57,6 +84,11 @@ def cmd_simulate(args: argparse.Namespace) -> int:
             out.close()
     print(f"generated {args.count} txns ({n_fraud} fraud)", file=sys.stderr)
     return 0
+
+
+def _addr(spec: str, default_port: int) -> tuple[str, int]:
+    host, _, port = spec.partition(":")
+    return host or "127.0.0.1", int(port or default_port)
 
 
 def cmd_run_job(args: argparse.Namespace) -> int:
@@ -79,8 +111,21 @@ def cmd_run_job(args: argparse.Namespace) -> int:
     gen = TransactionGenerator(num_users=args.users,
                                num_merchants=args.merchants,
                                seed=args.seed, tps=args.tps)
-    broker = InMemoryBroker()
-    scorer = FraudScorer(scorer_config=ScorerConfig())
+    if args.broker:
+        from realtime_fraud_detection_tpu.stream import NetBrokerClient
+
+        bhost, bport = _addr(args.broker, 9092)
+        broker = NetBrokerClient(host=bhost, port=bport)
+    else:
+        broker = InMemoryBroker()
+    state_client = None
+    if args.state:
+        from realtime_fraud_detection_tpu.state import RespClient
+
+        shost, sport = _addr(args.state, 6379)
+        state_client = RespClient(host=shost, port=sport)
+    scorer = FraudScorer(scorer_config=ScorerConfig(),
+                         state_client=state_client)
     scorer.seed_profiles(gen.users.profiles(), gen.merchants.profiles())
     job = StreamJob(broker, scorer, JobConfig(
         max_batch=args.batch, enable_analytics=args.analytics,
@@ -96,9 +141,31 @@ def cmd_run_job(args: argparse.Namespace) -> int:
     if args.checkpoint_dir:
         ckpt = CheckpointManager(args.checkpoint_dir)
 
+    def _checkpoint_step(step: int) -> None:
+        if ckpt is None:
+            return
+        t_ck = time.perf_counter()
+        path = ckpt.save(
+            step, params=scorer.models,
+            host_state=snapshot_scorer_host_state(scorer),
+            offsets=job.consumer.positions())
+        if metadata is not None:
+            metadata.record_checkpoint(
+                job_id, step, str(path),
+                duration_ms=(time.perf_counter() - t_ck) * 1e3)
+
     t0 = time.perf_counter()
     produced = scored = step = 0
     try:
+        if args.count == 0:
+            # consume-only: an external simulator feeds the broker; run in
+            # checkpointed slices until --duration elapses (0 = forever)
+            while args.duration <= 0 or time.perf_counter() - t0 < args.duration:
+                scored += job.run_for(
+                    min(10.0, args.duration - (time.perf_counter() - t0))
+                    if args.duration > 0 else 10.0)
+                step += 1
+                _checkpoint_step(step)
         while produced < args.count:
             chunk = min(args.count - produced, 10_000)
             records = gen.generate_batch(chunk)
@@ -107,16 +174,7 @@ def cmd_run_job(args: argparse.Namespace) -> int:
             produced += chunk
             scored += job.run_until_drained()
             step += 1
-            if ckpt is not None:
-                t_ck = time.perf_counter()
-                path = ckpt.save(
-                    step, params=scorer.models,
-                    host_state=snapshot_scorer_host_state(scorer),
-                    offsets=job.consumer.positions())
-                if metadata is not None:
-                    metadata.record_checkpoint(
-                        job_id, step, str(path),
-                        duration_ms=(time.perf_counter() - t_ck) * 1e3)
+            _checkpoint_step(step)
     except BaseException:
         if metadata is not None:
             metadata.set_job_status(job_id, "FAILED")
@@ -152,7 +210,20 @@ def cmd_serve(args: argparse.Namespace) -> int:
         config.serving.host = args.host
     if args.port is not None:
         config.serving.port = args.port
-    app = ServingApp(config=config)
+    scorer = None
+    state_addr = args.state or os.environ.get("RTFD_STATE_ADDR", "")
+    if state_addr:
+        from realtime_fraud_detection_tpu.scoring import (
+            FraudScorer,
+            ScorerConfig,
+        )
+        from realtime_fraud_detection_tpu.state import RespClient
+
+        shost, sport = _addr(state_addr, 6379)
+        scorer = FraudScorer(config, scorer_config=ScorerConfig(),
+                             state_client=RespClient(host=shost, port=sport))
+        print(f"using shared state tier at {state_addr}", file=sys.stderr)
+    app = ServingApp(config=config, scorer=scorer)
     if args.checkpoint_dir:
         from realtime_fraud_detection_tpu.checkpoint import CheckpointManager
 
@@ -229,23 +300,11 @@ def cmd_train(args: argparse.Namespace) -> int:
         models = models.replace(lstm=lstm, gnn=gnn, bert=bert)
 
     mgr = CheckpointManager(args.out)
+    # model_shapes (restore-compatibility dims) is auto-derived by save()
+    # into the manifest; metadata stays purely user-facing.
     path = mgr.save(0, params=models,
                     metadata={"rows": args.rows, "auc": auc,
-                              "fraud_rate": float(y.mean()),
-                              "model_shapes": {
-                                  "trees": [trees.n_trees, trees.depth],
-                                  "iforest": [
-                                      iforest.n_trees,
-                                      int(iforest.path_length.shape[1]
-                                          ).bit_length() - 1,
-                                  ],
-                                  # restore-compatibility guard dims
-                                  "bert_hidden":
-                                      models.bert["word_emb"].shape[1],
-                                  "bert_layers": len(models.bert["layers"]),
-                                  "feature_dim": 64,
-                                  "node_dim": 16,
-                              }})
+                              "fraud_rate": float(y.mean())})
     print(json.dumps({"auc": round(auc, 4),
                       "fraud_rate": round(float(y.mean()), 4),
                       "neural_trained": bool(args.neural),
@@ -293,6 +352,44 @@ def cmd_bench(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_broker(args: argparse.Namespace) -> int:
+    """Run the standalone durable log broker (the Kafka-role process of a
+    multi-service deployment; stream/netbroker.py). Blocks until SIGINT."""
+    from realtime_fraud_detection_tpu.stream.netbroker import BrokerServer
+
+    server = BrokerServer(host=args.host, port=args.port,
+                          log_dir=args.log_dir or None).start()
+    print(f"broker listening on {args.host}:{server.port}"
+          + (f" (log_dir={args.log_dir})" if args.log_dir else ""),
+          file=sys.stderr)
+    try:
+        threading_event_wait()
+    finally:
+        server.stop()
+    return 0
+
+
+def cmd_state_server(args: argparse.Namespace) -> int:
+    """Run the shared state node (Redis-protocol; state/resp.py) — the
+    RedisService-role process N scorer replicas share. Blocks until SIGINT."""
+    from realtime_fraud_detection_tpu.state.resp import MiniRedisServer
+
+    server = MiniRedisServer(host=args.host, port=args.port).start()
+    print(f"state server (RESP) listening on {args.host}:{server.port}",
+          file=sys.stderr)
+    try:
+        threading_event_wait()
+    finally:
+        server.stop()
+    return 0
+
+
+def threading_event_wait() -> None:  # pragma: no cover - blocks forever
+    import threading
+
+    threading.Event().wait()
+
+
 def cmd_health_check(args: argparse.Namespace) -> int:
     """Probe a running scoring service (health-check.sh analog)."""
     import urllib.error
@@ -330,11 +427,22 @@ def build_parser() -> argparse.ArgumentParser:
     _add_sim_args(sp)
     sp.add_argument("--count", type=int, default=1000)
     sp.add_argument("--output", default="-")
+    sp.add_argument("--broker", default="",
+                    help="produce to a broker (host:port) at ~tps instead "
+                         "of writing JSON lines")
     sp.set_defaults(fn=cmd_simulate)
 
     sp = sub.add_parser("run-job", help="run the streaming scoring job")
     _add_sim_args(sp)
-    sp.add_argument("--count", type=int, default=10_000)
+    sp.add_argument("--count", type=int, default=10_000,
+                    help="self-generate this many txns; 0 = consume-only "
+                         "from --broker")
+    sp.add_argument("--duration", type=float, default=0.0,
+                    help="consume-only runtime seconds (0 = forever)")
+    sp.add_argument("--broker", default="",
+                    help="external broker host:port (default: in-memory)")
+    sp.add_argument("--state", default="",
+                    help="shared state server host:port (RESP)")
     sp.add_argument("--batch", type=int, default=256)
     sp.add_argument("--analytics", action="store_true",
                     help="attach the windowed-analytics stage")
@@ -350,6 +458,9 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("serve", help="run the scoring HTTP service")
     sp.add_argument("--host", default="")
     sp.add_argument("--port", type=int, default=None)
+    sp.add_argument("--state", default="",
+                    help="shared state server host:port (RESP); also "
+                         "honors RTFD_STATE_ADDR")
     sp.add_argument("--config", default="", help="JSON config file")
     sp.add_argument("--checkpoint-dir", default="",
                     help="restore model params (e.g. from `train`) at startup")
@@ -364,6 +475,19 @@ def build_parser() -> argparse.ArgumentParser:
                     help="also train the LSTM/GNN/BERT branches")
     sp.add_argument("--out", default="./checkpoints")
     sp.set_defaults(fn=cmd_train)
+
+    sp = sub.add_parser("broker", help="run the durable log broker (TCP)")
+    sp.add_argument("--host", default="0.0.0.0")
+    sp.add_argument("--port", type=int, default=9092)
+    sp.add_argument("--log-dir", default="",
+                    help="write-ahead segment dir (empty = in-memory only)")
+    sp.set_defaults(fn=cmd_broker)
+
+    sp = sub.add_parser("state-server",
+                        help="run the shared state server (Redis protocol)")
+    sp.add_argument("--host", default="0.0.0.0")
+    sp.add_argument("--port", type=int, default=6379)
+    sp.set_defaults(fn=cmd_state_server)
 
     sp = sub.add_parser("bench", help="run the TPU benchmark")
     sp.set_defaults(fn=cmd_bench)

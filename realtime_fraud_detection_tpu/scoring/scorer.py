@@ -62,6 +62,26 @@ from realtime_fraud_detection_tpu.state.stores import (
 from realtime_fraud_detection_tpu.utils.config import Config
 
 
+import dataclasses
+
+
+@dataclasses.dataclass
+class PendingScore:
+    """A dispatched-but-not-finalized microbatch.
+
+    ``out`` holds device arrays still being computed (JAX async dispatch);
+    ``features`` is the host copy of this batch's 64-wide feature rows,
+    captured at dispatch time because a later dispatch overwrites the
+    scorer's ``last_features``.
+    """
+
+    records: List[Mapping[str, Any]]
+    n: int
+    out: Any
+    features: np.ndarray
+    t0: float
+
+
 class _EntityIndex:
     """Stable string-id -> dense int index with on-the-fly node features."""
 
@@ -141,6 +161,7 @@ class FraudScorer:
         scorer_config: Optional[ScorerConfig] = None,
         bert_config: BertConfig = TINY_CONFIG,
         seed: int = 0,
+        state_client=None,
     ):
         self.config = config or Config()
         self.sc = scorer_config or ScorerConfig()
@@ -156,12 +177,28 @@ class FraudScorer:
             [n in enabled for n in MODEL_NAMES], bool
         )
 
-        # streaming state (the Redis-equivalent plane, SURVEY.md §2.5)
-        self.profiles = ProfileStore()
-        self.velocity = VelocityStore()
+        # streaming state (the Redis-equivalent plane, SURVEY.md §2.5).
+        # Default: in-process single-writer stores (state lives with the
+        # microbatcher — no network hop in the hot loop). With
+        # ``state_client`` (a state.RespClient), profiles/velocity/txn-cache
+        # move to the shared RESP tier so N replicas share one state plane
+        # (state/shared.py; the reference's Redis role).
+        if state_client is not None:
+            from realtime_fraud_detection_tpu.state.shared import (
+                SharedProfileStore,
+                SharedTransactionCache,
+                SharedVelocityStore,
+            )
+
+            self.profiles = SharedProfileStore(state_client)
+            self.velocity = SharedVelocityStore(state_client)
+            self.txn_cache = SharedTransactionCache(state_client)
+        else:
+            self.profiles = ProfileStore()
+            self.velocity = VelocityStore()
+            self.txn_cache = TransactionCache()
         self.history = UserHistoryStore(self.sc.seq_len, self.sc.feature_dim)
         self.graph = EntityGraphStore(self.sc.fanout)
-        self.txn_cache = TransactionCache()
         self.tokenizer = FraudTokenizer(
             vocab_size=bert_config.vocab_size, max_length=self.sc.text_len
         )
@@ -245,13 +282,23 @@ class FraudScorer:
         )
 
     # ----------------------------------------------------------------- scoring
-    def score_batch(self, records: Sequence[Mapping[str, Any]],
-                    now: Optional[float] = None) -> List[Dict[str, Any]]:
-        """Score transaction dicts -> FraudPrediction dicts (§2.7 schema)."""
+    def dispatch(self, records: Sequence[Mapping[str, Any]],
+                 now: Optional[float] = None) -> "PendingScore":
+        """Assemble + launch the fused device program WITHOUT blocking.
+
+        JAX dispatch is async: the returned ``PendingScore`` holds device
+        arrays still being computed, so the caller can assemble/dispatch the
+        next microbatch (or do fan-out work) while the TPU runs this one.
+        ``finalize`` blocks, builds §2.7 responses, and write-backs state.
+        This is the in-path version of stream/microbatch.DoubleBufferedScorer
+        — host→device pipelining, the reference operator pipeline's analog
+        (SURVEY.md §2.8).
+        """
         t0 = time.perf_counter()
         n = len(records)
         if n == 0:
-            return []
+            return PendingScore(records=[], n=0, out=None,
+                                features=self.last_features[:0], t0=t0)
         batch = self.assemble(records, now)
         padded, mask, _ = pad_to_bucket(
             batch, n, BATCH_BUCKETS, multiple_of=local_mesh_size(self.mesh)
@@ -265,15 +312,36 @@ class FraudScorer:
             jax.device_put(self.model_valid),
             bert_config=self.bert_config, use_pallas=self.sc.use_pallas,
         )
-        out = jax.device_get(out)
+        return PendingScore(records=list(records), n=n, out=out,
+                            features=self.last_features, t0=t0)
 
-        elapsed_ms = (time.perf_counter() - t0) * 1000.0
-        results = self._build_responses(records, out, n, elapsed_ms)
-        self._write_back(records, results, now)
-        self.stats["scored"] += n
-        self.stats["batches"] += 1
-        self.stats["total_time_s"] += elapsed_ms / 1000.0
+    def finalize(self, pending: "PendingScore", now: Optional[float] = None,
+                 lock=None) -> List[Dict[str, Any]]:
+        """Block on a dispatched batch, build responses, write back state.
+
+        ``lock`` (optional) is held only around the state write-back, not
+        the device wait — a concurrent caller can assemble/dispatch the next
+        batch while this one's device result is still in flight.
+        """
+        import contextlib
+
+        if pending.n == 0:
+            return []
+        out = jax.device_get(pending.out)      # blocks until device is done
+        elapsed_ms = (time.perf_counter() - pending.t0) * 1000.0
+        results = self._build_responses(pending.records, out, pending.n,
+                                        elapsed_ms)
+        with (lock if lock is not None else contextlib.nullcontext()):
+            self._write_back(pending.records, results, now)
+            self.stats["scored"] += pending.n
+            self.stats["batches"] += 1
+            self.stats["total_time_s"] += elapsed_ms / 1000.0
         return results
+
+    def score_batch(self, records: Sequence[Mapping[str, Any]],
+                    now: Optional[float] = None) -> List[Dict[str, Any]]:
+        """Score transaction dicts -> FraudPrediction dicts (§2.7 schema)."""
+        return self.finalize(self.dispatch(records, now), now)
 
     def _build_responses(self, records, out, n, elapsed_ms) -> List[Dict[str, Any]]:
         probs = np.asarray(out["fraud_probability"])[:n]

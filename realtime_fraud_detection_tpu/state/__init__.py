@@ -5,6 +5,16 @@ from realtime_fraud_detection_tpu.state.stores import (  # noqa: F401
     AggregationStore,
     StateBackend,
 )
+from realtime_fraud_detection_tpu.state.resp import (  # noqa: F401
+    MiniRedisServer,
+    RespClient,
+)
+from realtime_fraud_detection_tpu.state.shared import (  # noqa: F401
+    SharedAggregationStore,
+    SharedProfileStore,
+    SharedTransactionCache,
+    SharedVelocityStore,
+)
 from realtime_fraud_detection_tpu.state.history import (  # noqa: F401
     UserHistoryStore,
     EntityGraphStore,

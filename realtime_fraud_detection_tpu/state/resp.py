@@ -1,0 +1,585 @@
+"""RESP (REdis Serialization Protocol) client + mini server, stdlib-only.
+
+The reference keeps all shared online state in Redis — profiles, txn cache,
+velocity hashes, feature JSON, aggregations (RedisService.java:36-49,
+config/redis/redis-master.conf). This framework's default stores are
+in-process (state/stores.py keeps the hot loop off the network), but a
+multi-replica serving tier needs a *shared* plane: ``RespClient`` speaks
+RESP2 to any Redis-compatible server, and ``MiniRedisServer`` is a
+Redis-protocol-compatible in-process server (strings, hashes, lists, TTLs)
+so shared-state deployments and tests work in this image, where no Redis
+binary exists.
+
+Command subset (what the §2.5 key schema needs): PING, GET, SET [EX], SETEX,
+SETNX, DEL, EXISTS, EXPIRE, TTL, INCR, INCRBYFLOAT, HSET, HSETNX, HGET,
+HGETALL, HINCRBY, HINCRBYFLOAT, HDEL, LPUSH, LTRIM, LRANGE, LLEN, KEYS,
+FLUSHDB, DBSIZE. Hash-field increments are atomic server-side — that is the
+fix for the reference's GET-then-SET velocity races
+(RedisTransactionSink.java:116-135) when replicas share a user.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import socket
+import socketserver
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = ["RespClient", "MiniRedisServer", "RespError"]
+
+
+class RespError(RuntimeError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# codec
+# ---------------------------------------------------------------------------
+
+
+def encode_command(args: Tuple[Any, ...]) -> bytes:
+    out = [b"*%d\r\n" % len(args)]
+    for a in args:
+        if isinstance(a, bytes):
+            b = a
+        elif isinstance(a, str):
+            b = a.encode()
+        elif isinstance(a, float):
+            b = repr(a).encode()
+        else:
+            b = str(a).encode()
+        out.append(b"$%d\r\n%s\r\n" % (len(b), b))
+    return b"".join(out)
+
+
+class _SockReader:
+    def __init__(self, sock: socket.socket):
+        self._sock = sock
+        self._buf = bytearray()
+
+    def read_line(self) -> bytes:
+        while True:
+            i = self._buf.find(b"\r\n")
+            if i >= 0:
+                line = bytes(self._buf[:i])
+                del self._buf[: i + 2]
+                return line
+            chunk = self._sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("connection closed")
+            self._buf.extend(chunk)
+
+    def read_exact(self, n: int) -> bytes:
+        while len(self._buf) < n + 2:
+            chunk = self._sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("connection closed")
+            self._buf.extend(chunk)
+        data = bytes(self._buf[:n])
+        del self._buf[: n + 2]          # strip trailing \r\n
+        return data
+
+    def read_value(self) -> Any:
+        line = self.read_line()
+        kind, rest = line[:1], line[1:]
+        if kind == b"+":
+            return rest.decode()
+        if kind == b"-":
+            raise RespError(rest.decode())
+        if kind == b":":
+            return int(rest)
+        if kind == b"$":
+            n = int(rest)
+            return None if n < 0 else self.read_exact(n)
+        if kind == b"*":
+            n = int(rest)
+            return None if n < 0 else [self.read_value() for _ in range(n)]
+        raise RespError(f"bad RESP type byte {kind!r}")
+
+
+# ---------------------------------------------------------------------------
+# client
+# ---------------------------------------------------------------------------
+
+
+class RespClient:
+    """One-connection Redis client. Thread-safe (requests serialized)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 6379,
+                 timeout_s: float = 30.0):
+        self._sock = socket.create_connection((host, port), timeout=timeout_s)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._reader = _SockReader(self._sock)
+        self._lock = threading.Lock()
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def execute(self, *args: Any) -> Any:
+        with self._lock:
+            self._sock.sendall(encode_command(args))
+            return self._reader.read_value()
+
+    # ------------------------------------------------------------- strings
+    def ping(self) -> bool:
+        return self.execute("PING") == "PONG"
+
+    def get(self, key: str) -> Optional[bytes]:
+        return self.execute("GET", key)
+
+    def set(self, key: str, value: Any, ex: Optional[float] = None) -> None:
+        if ex is not None:
+            self.execute("SET", key, value, "PX", int(ex * 1000))
+        else:
+            self.execute("SET", key, value)
+
+    def setnx(self, key: str, value: Any) -> bool:
+        return self.execute("SETNX", key, value) == 1
+
+    def delete(self, *keys: str) -> int:
+        return self.execute("DEL", *keys)
+
+    def exists(self, key: str) -> bool:
+        return self.execute("EXISTS", key) == 1
+
+    def expire(self, key: str, seconds: float) -> bool:
+        return self.execute("PEXPIRE", key, int(seconds * 1000)) == 1
+
+    def incr(self, key: str) -> int:
+        return self.execute("INCR", key)
+
+    def incrbyfloat(self, key: str, amount: float) -> float:
+        return float(self.execute("INCRBYFLOAT", key, amount))
+
+    # -------------------------------------------------------------- hashes
+    def hset(self, key: str, *pairs: Any) -> int:
+        return self.execute("HSET", key, *pairs)
+
+    def hsetnx(self, key: str, field: str, value: Any) -> bool:
+        return self.execute("HSETNX", key, field, value) == 1
+
+    def hget(self, key: str, field: str) -> Optional[bytes]:
+        return self.execute("HGET", key, field)
+
+    def hgetall(self, key: str) -> Dict[str, bytes]:
+        flat = self.execute("HGETALL", key) or []
+        return {flat[i].decode(): flat[i + 1] for i in range(0, len(flat), 2)}
+
+    def hincrby(self, key: str, field: str, amount: int = 1) -> int:
+        return self.execute("HINCRBY", key, field, amount)
+
+    def hincrbyfloat(self, key: str, field: str, amount: float) -> float:
+        return float(self.execute("HINCRBYFLOAT", key, field, amount))
+
+    # --------------------------------------------------------------- lists
+    def lpush(self, key: str, *values: Any) -> int:
+        return self.execute("LPUSH", key, *values)
+
+    def ltrim(self, key: str, start: int, stop: int) -> None:
+        self.execute("LTRIM", key, start, stop)
+
+    def lrange(self, key: str, start: int, stop: int) -> List[bytes]:
+        return self.execute("LRANGE", key, start, stop) or []
+
+    def llen(self, key: str) -> int:
+        return self.execute("LLEN", key)
+
+    # --------------------------------------------------------------- admin
+    def keys(self, pattern: str = "*") -> List[bytes]:
+        return self.execute("KEYS", pattern) or []
+
+    def flushdb(self) -> None:
+        self.execute("FLUSHDB")
+
+    def dbsize(self) -> int:
+        return self.execute("DBSIZE")
+
+
+# ---------------------------------------------------------------------------
+# mini server
+# ---------------------------------------------------------------------------
+
+
+class _Store:
+    """The keyspace: key -> (value, expires_at_ms|None). Values are bytes
+    (strings), dict (hashes), or list (lists). One lock — command atomicity
+    is the contract that matters (HINCRBY etc.), not parallelism."""
+
+    def __init__(self) -> None:
+        self.data: Dict[bytes, Tuple[Any, Optional[float]]] = {}
+        self.lock = threading.Lock()
+
+    def now_ms(self) -> float:
+        return time.time() * 1000.0
+
+    def live(self, key: bytes) -> Optional[Any]:
+        item = self.data.get(key)
+        if item is None:
+            return None
+        value, exp = item
+        if exp is not None and self.now_ms() >= exp:
+            del self.data[key]
+            return None
+        return value
+
+    def put(self, key: bytes, value: Any,
+            expires_at_ms: Optional[float] = None) -> None:
+        self.data[key] = (value, expires_at_ms)
+
+    def keep_ttl_put(self, key: bytes, value: Any) -> None:
+        old = self.data.get(key)
+        self.data[key] = (value, old[1] if old else None)
+
+
+def _num(b: bytes) -> float:
+    return float(b)
+
+
+def _fmt_float(v: float) -> bytes:
+    s = f"{v:.17g}"
+    return s.encode()
+
+
+class _RespHandler(socketserver.BaseRequestHandler):
+    def handle(self) -> None:
+        server: MiniRedisServer = self.server.outer  # type: ignore[attr-defined]
+        reader = _SockReader(self.request)
+        while True:
+            try:
+                cmd = reader.read_value()
+            except (ConnectionError, RespError):
+                return
+            if not isinstance(cmd, list) or not cmd:
+                return
+            try:
+                resp = server.run_command([bytes(c) for c in cmd])
+            except RespError as e:
+                resp = e
+            except Exception as e:  # noqa: BLE001
+                resp = RespError(f"ERR {type(e).__name__}: {e}")
+            try:
+                self.request.sendall(_encode_reply(resp))
+            except OSError:
+                return
+
+
+def _encode_reply(v: Any) -> bytes:
+    if isinstance(v, RespError):
+        return b"-%s\r\n" % str(v).encode()
+    if v is True:
+        return b"+OK\r\n"
+    if isinstance(v, str):
+        return b"+%s\r\n" % v.encode()
+    if isinstance(v, bool):
+        return b":%d\r\n" % int(v)
+    if isinstance(v, int):
+        return b":%d\r\n" % v
+    if v is None:
+        return b"$-1\r\n"
+    if isinstance(v, bytes):
+        return b"$%d\r\n%s\r\n" % (len(v), v)
+    if isinstance(v, list):
+        return b"*%d\r\n" % len(v) + b"".join(_encode_reply(x) for x in v)
+    raise TypeError(f"cannot encode {type(v)}")
+
+
+class _TCPServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class MiniRedisServer:
+    """Redis-protocol-compatible server over an in-process keyspace."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._store = _Store()
+        self._tcp = _TCPServer((host, port), _RespHandler)
+        self._tcp.outer = self  # type: ignore[attr-defined]
+        self._thread = threading.Thread(
+            target=self._tcp.serve_forever, name="mini-redis", daemon=True)
+
+    def start(self) -> "MiniRedisServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._tcp.shutdown()
+        self._tcp.server_close()
+
+    @property
+    def port(self) -> int:
+        return self._tcp.server_address[1]
+
+    # ------------------------------------------------------------- commands
+    def run_command(self, parts: List[bytes]) -> Any:
+        name = parts[0].upper().decode()
+        args = parts[1:]
+        s = self._store
+        with s.lock:
+            handler = getattr(self, f"_cmd_{name.lower()}", None)
+            if handler is None:
+                raise RespError(f"ERR unknown command '{name}'")
+            return handler(s, args)
+
+    # strings ---------------------------------------------------------------
+    @staticmethod
+    def _cmd_ping(s: _Store, args) -> str:
+        return args[0].decode() if args else "PONG"
+
+    @staticmethod
+    def _cmd_get(s: _Store, args):
+        v = s.live(args[0])
+        if v is not None and not isinstance(v, bytes):
+            raise RespError("WRONGTYPE Operation against a key holding the "
+                            "wrong kind of value")
+        return v
+
+    @staticmethod
+    def _cmd_set(s: _Store, args) -> Any:
+        key, value, rest = args[0], args[1], args[2:]
+        expires = None
+        i = 0
+        nx = xx = False
+        while i < len(rest):
+            opt = rest[i].upper()
+            if opt == b"EX":
+                expires = s.now_ms() + float(rest[i + 1]) * 1000.0
+                i += 2
+            elif opt == b"PX":
+                expires = s.now_ms() + float(rest[i + 1])
+                i += 2
+            elif opt == b"NX":
+                nx = True
+                i += 1
+            elif opt == b"XX":
+                xx = True
+                i += 1
+            else:
+                raise RespError(f"ERR syntax error near {opt!r}")
+        exists = s.live(key) is not None
+        if (nx and exists) or (xx and not exists):
+            return None
+        s.put(key, value, expires)
+        return True
+
+    @staticmethod
+    def _cmd_setex(s: _Store, args) -> Any:
+        key, seconds, value = args
+        s.put(key, value, s.now_ms() + float(seconds) * 1000.0)
+        return True
+
+    @staticmethod
+    def _cmd_setnx(s: _Store, args) -> int:
+        if s.live(args[0]) is not None:
+            return 0
+        s.put(args[0], args[1])
+        return 1
+
+    @staticmethod
+    def _cmd_del(s: _Store, args) -> int:
+        n = 0
+        for key in args:
+            if s.live(key) is not None:
+                del s.data[key]
+                n += 1
+        return n
+
+    @staticmethod
+    def _cmd_exists(s: _Store, args) -> int:
+        return sum(1 for key in args if s.live(key) is not None)
+
+    @staticmethod
+    def _cmd_expire(s: _Store, args) -> int:
+        if s.live(args[0]) is None:
+            return 0
+        value, _ = s.data[args[0]]
+        s.put(args[0], value, s.now_ms() + float(args[1]) * 1000.0)
+        return 1
+
+    @staticmethod
+    def _cmd_pexpire(s: _Store, args) -> int:
+        if s.live(args[0]) is None:
+            return 0
+        value, _ = s.data[args[0]]
+        s.put(args[0], value, s.now_ms() + float(args[1]))
+        return 1
+
+    @staticmethod
+    def _cmd_ttl(s: _Store, args) -> int:
+        if s.live(args[0]) is None:
+            return -2
+        _, exp = s.data[args[0]]
+        if exp is None:
+            return -1
+        return max(0, int((exp - s.now_ms()) / 1000.0))
+
+    @staticmethod
+    def _cmd_incr(s: _Store, args) -> int:
+        v = s.live(args[0])
+        cur = int(v) if v is not None else 0
+        cur += 1
+        s.keep_ttl_put(args[0], str(cur).encode())
+        return cur
+
+    @staticmethod
+    def _cmd_incrbyfloat(s: _Store, args) -> bytes:
+        v = s.live(args[0])
+        cur = _num(v) if v is not None else 0.0
+        cur += _num(args[1])
+        out = _fmt_float(cur)
+        s.keep_ttl_put(args[0], out)
+        return out
+
+    # hashes ----------------------------------------------------------------
+    @staticmethod
+    def _hash(s: _Store, key: bytes) -> Dict[bytes, bytes]:
+        v = s.live(key)
+        if v is None:
+            v = {}
+            s.put(key, v)
+        elif not isinstance(v, dict):
+            raise RespError("WRONGTYPE Operation against a key holding the "
+                            "wrong kind of value")
+        return v
+
+    @classmethod
+    def _cmd_hset(cls, s: _Store, args) -> int:
+        h = cls._hash(s, args[0])
+        added = 0
+        for i in range(1, len(args), 2):
+            if args[i] not in h:
+                added += 1
+            h[args[i]] = args[i + 1]
+        return added
+
+    @classmethod
+    def _cmd_hsetnx(cls, s: _Store, args) -> int:
+        h = cls._hash(s, args[0])
+        if args[1] in h:
+            return 0
+        h[args[1]] = args[2]
+        return 1
+
+    @classmethod
+    def _cmd_hget(cls, s: _Store, args):
+        v = s.live(args[0])
+        if v is None:
+            return None
+        if not isinstance(v, dict):
+            raise RespError("WRONGTYPE Operation against a key holding the "
+                            "wrong kind of value")
+        return v.get(args[1])
+
+    @classmethod
+    def _cmd_hgetall(cls, s: _Store, args) -> list:
+        v = s.live(args[0])
+        if v is None:
+            return []
+        if not isinstance(v, dict):
+            raise RespError("WRONGTYPE Operation against a key holding the "
+                            "wrong kind of value")
+        out = []
+        for field, val in v.items():
+            out.extend((field, val))
+        return out
+
+    @classmethod
+    def _cmd_hincrby(cls, s: _Store, args) -> int:
+        h = cls._hash(s, args[0])
+        cur = int(h.get(args[1], b"0")) + int(args[2])
+        h[args[1]] = str(cur).encode()
+        return cur
+
+    @classmethod
+    def _cmd_hincrbyfloat(cls, s: _Store, args) -> bytes:
+        h = cls._hash(s, args[0])
+        cur = _num(h.get(args[1], b"0")) + _num(args[2])
+        out = _fmt_float(cur)
+        h[args[1]] = out
+        return out
+
+    @classmethod
+    def _cmd_hdel(cls, s: _Store, args) -> int:
+        v = s.live(args[0])
+        if not isinstance(v, dict):
+            return 0
+        n = 0
+        for field in args[1:]:
+            if field in v:
+                del v[field]
+                n += 1
+        return n
+
+    # lists -----------------------------------------------------------------
+    @staticmethod
+    def _list(s: _Store, key: bytes) -> list:
+        v = s.live(key)
+        if v is None:
+            v = []
+            s.put(key, v)
+        elif not isinstance(v, list):
+            raise RespError("WRONGTYPE Operation against a key holding the "
+                            "wrong kind of value")
+        return v
+
+    @classmethod
+    def _cmd_lpush(cls, s: _Store, args) -> int:
+        lst = cls._list(s, args[0])
+        for v in args[1:]:
+            lst.insert(0, v)
+        return len(lst)
+
+    @classmethod
+    def _cmd_ltrim(cls, s: _Store, args) -> bool:
+        lst = cls._list(s, args[0])
+        start, stop = int(args[1]), int(args[2])
+        n = len(lst)
+        if start < 0:
+            start += n
+        if stop < 0:
+            stop += n
+        lst[:] = lst[max(0, start): stop + 1]
+        return True
+
+    @classmethod
+    def _cmd_lrange(cls, s: _Store, args) -> list:
+        v = s.live(args[0])
+        if v is None:
+            return []
+        if not isinstance(v, list):
+            raise RespError("WRONGTYPE Operation against a key holding the "
+                            "wrong kind of value")
+        start, stop = int(args[1]), int(args[2])
+        n = len(v)
+        if start < 0:
+            start += n
+        if stop < 0:
+            stop += n
+        return list(v[max(0, start): stop + 1])
+
+    @classmethod
+    def _cmd_llen(cls, s: _Store, args) -> int:
+        v = s.live(args[0])
+        return len(v) if isinstance(v, list) else 0
+
+    # admin -----------------------------------------------------------------
+    @staticmethod
+    def _cmd_keys(s: _Store, args) -> list:
+        pattern = (args[0] if args else b"*").decode()
+        return [k for k in list(s.data)
+                if s.live(k) is not None
+                and fnmatch.fnmatchcase(k.decode(), pattern)]
+
+    @staticmethod
+    def _cmd_flushdb(s: _Store, args) -> bool:
+        s.data.clear()
+        return True
+
+    @staticmethod
+    def _cmd_dbsize(s: _Store, args) -> int:
+        return sum(1 for k in list(s.data) if s.live(k) is not None)

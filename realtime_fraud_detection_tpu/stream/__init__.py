@@ -16,6 +16,11 @@ from realtime_fraud_detection_tpu.stream.transport import (  # noqa: F401
     KafkaTransport,
     Record,
 )
+from realtime_fraud_detection_tpu.stream.kafka import KafkaBroker  # noqa: F401
+from realtime_fraud_detection_tpu.stream.netbroker import (  # noqa: F401
+    BrokerServer,
+    NetBrokerClient,
+)
 from realtime_fraud_detection_tpu.stream.microbatch import (  # noqa: F401
     DoubleBufferedScorer,
     MicrobatchAssembler,

@@ -54,8 +54,24 @@ class JobConfig:
     enable_enrichment: bool = False
 
 
+@dataclasses.dataclass
+class _BatchCtx:
+    """A microbatch between dispatch and completion (device in flight)."""
+
+    fresh: List[Record]
+    ids: set
+    pending: Any                      # scoring.scorer.PendingScore | None
+    positions: Dict[tuple, int]       # offsets to commit at completion
+    now: Optional[float]
+
+
 class StreamJob:
-    """Consume → score → fan out → commit. One instance per process."""
+    """Consume → score → fan out → commit. One instance per process.
+
+    The run loops are two-deep pipelined: while the device computes batch N,
+    the host polls + assembles + dispatches batch N+1, then completes batch
+    N (fan-out + offset commit, always in dispatch order).
+    """
 
     def __init__(
         self,
@@ -82,34 +98,72 @@ class StreamJob:
             "scored": 0, "alerts": 0, "batches": 0, "duplicates_skipped": 0,
             "errors": 0,
         }
+        # transaction_ids dispatched but not yet written back: the pipelined
+        # loop dedupes batch N+1 against these before batch N lands in the
+        # txn cache (keeps effectively-once scoring under pipelining)
+        self._inflight_ids: set = set()
 
     # ----------------------------------------------------------------- steps
     def process_batch(self, records: List[Record],
                       now: Optional[float] = None) -> List[Dict[str, Any]]:
         """Score one microbatch and fan results out to the output topics."""
-        cfg = self.config
+        ctx = self.dispatch_batch(records, now=now)
+        return self.complete_batch(ctx) if ctx is not None else []
+
+    def dispatch_batch(self, records: List[Record],
+                       now: Optional[float] = None) -> Optional["_BatchCtx"]:
+        """Stage 1 of the pipelined step: dedupe + launch on device.
+
+        Returns without blocking on the device — the caller overlaps the
+        next batch's poll/assembly with this batch's compute and calls
+        ``complete_batch`` (in dispatch order) to fan out + commit. Offsets
+        are snapshotted HERE so a later poll can't advance what this
+        batch's commit covers.
+        """
+        if not records:
+            return None
         fresh: List[Record] = []
         batch_ids: set = set()
         for r in records:
             txn_id = str(r.value.get("transaction_id", f"{r.partition}:{r.offset}"))
             if (txn_id in batch_ids  # duplicate within this very batch
+                    or txn_id in self._inflight_ids  # in a dispatched batch
                     or self.scorer.txn_cache.get_transaction(txn_id, now=now)
                     is not None):
                 self.counters["duplicates_skipped"] += 1  # replay/dup dedupe
                 continue
             batch_ids.add(txn_id)
             fresh.append(r)
+        positions = self.consumer.snapshot_positions()
         if not fresh:
-            self.consumer.commit()
+            return _BatchCtx([], set(), None, positions, now)
+        pending = None
+        try:
+            pending = self.scorer.dispatch([r.value for r in fresh], now=now)
+        except Exception:
+            # degradation path (TransactionProcessor.java:83-91): score 0.5,
+            # REVIEW, keep the stream alive; counted at completion
+            pass
+        self._inflight_ids |= batch_ids
+        return _BatchCtx(fresh, batch_ids, pending, positions, now)
+
+    def complete_batch(self, ctx: "_BatchCtx") -> List[Dict[str, Any]]:
+        """Stage 2: block on the device result, fan out, commit offsets."""
+        cfg = self.config
+        fresh, now = ctx.fresh, ctx.now
+        if not fresh:
+            self.consumer.commit(ctx.positions)
             return []
 
-        scored_ok = True
-        try:
-            results = self.scorer.score_batch([r.value for r in fresh], now=now)
-        except Exception:
-            scored_ok = False
-            # degradation path (TransactionProcessor.java:83-91): score 0.5,
-            # REVIEW, keep the stream alive
+        scored_ok, results, feats = False, None, None
+        if ctx.pending is not None:
+            try:
+                results = self.scorer.finalize(ctx.pending, now=now)
+                feats = ctx.pending.features
+                scored_ok = True
+            except Exception:
+                results = None
+        if results is None:
             self.counters["errors"] += len(fresh)
             results = [
                 {
@@ -145,7 +199,7 @@ class StreamJob:
             # pad to the scoring buckets so blend_enrichment compiles once
             # per bucket, not once per tail-batch size
             (prior_p, feats_p), _, _ = pad_to_bucket(
-                (prior, self.scorer.last_features[:n]), n)
+                (prior, feats[:n]), n)
             blended, dec, risk = blend_enrichment(prior_p, feats_p)
             enriched_scores = (
                 np.asarray(blended)[:n],
@@ -180,18 +234,19 @@ class StreamJob:
                     self.analytics.process(
                         enriched, _event_time_ms(enriched, now) / 1000.0)
             # features exist only when scoring succeeded (the error fallback
-            # never ran assemble, so last_features would be absent/stale)
+            # never ran assemble, so there are no feature rows for the batch)
             if cfg.emit_features and scored_ok:
                 self.broker.produce(
                     T.FEATURES,
                     {"transaction_id": res["transaction_id"],
-                     "features": self.scorer.last_features[i].tolist()},
+                     "features": feats[i].tolist()},
                     key=uid,
                 )
         self.counters["scored"] += len(fresh)
         self.counters["batches"] += 1
+        self._inflight_ids -= ctx.ids
         # commit AFTER fan-out + scorer write-back: at-least-once
-        self.consumer.commit()
+        self.consumer.commit(ctx.positions)
         return results
 
     @staticmethod
@@ -214,23 +269,38 @@ class StreamJob:
                           now: Optional[float] = None) -> int:
         """Process until the input topic is fully consumed. Returns #scored."""
         start_scored = self.counters["scored"]
+        in_flight: Optional[_BatchCtx] = None
         for _ in range(max_batches):
             batch = self.assembler.next_batch(block=False)
             if not batch:
                 batch = self.assembler.flush()
             if not batch:
+                if in_flight is not None:
+                    self.complete_batch(in_flight)
+                    in_flight = None
+                    continue
                 if self.consumer.lag() == 0:
                     break
                 continue
-            self.process_batch(batch, now=now)
+            ctx = self.dispatch_batch(batch, now=now)
+            if in_flight is not None:
+                self.complete_batch(in_flight)
+            in_flight = ctx
+        if in_flight is not None:
+            self.complete_batch(in_flight)
         return self.counters["scored"] - start_scored
 
     def run_for(self, duration_s: float) -> int:
         """Process the stream for a wall-clock window (soak-test entry)."""
         t_end = time.monotonic() + duration_s
         start = self.counters["scored"]
+        in_flight: Optional[_BatchCtx] = None
         while time.monotonic() < t_end:
             batch = self.assembler.next_batch(block=True, timeout_s=0.05)
-            if batch:
-                self.process_batch(batch)
+            ctx = self.dispatch_batch(batch) if batch else None
+            if in_flight is not None:
+                self.complete_batch(in_flight)
+            in_flight = ctx
+        if in_flight is not None:
+            self.complete_batch(in_flight)
         return self.counters["scored"] - start

@@ -1,0 +1,306 @@
+"""In-process Kafka-protocol broker: the contract test double for KafkaBroker.
+
+A TCP server that speaks the same wire-protocol subset the client uses
+(Metadata v1, Produce v2, Fetch v2, ListOffsets v1, FindCoordinator v0,
+OffsetCommit v2, OffsetFetch v1) over an ``InMemoryBroker`` log. It exists
+so the Kafka transport's produce/fetch/commit logic — encoding, CRC,
+partitioning, offset bookkeeping — is exercised end-to-end over real
+sockets without a Kafka installation (none exists in this image; the
+reference gets its brokers from docker-compose.yml).
+
+This is a *fake*, not a broker: one node, no replication, no rebalance
+protocol, topics auto-created on first touch with the framework's
+partition counts (stream/topics.py). Request decoding here is written
+against the public protocol spec (kafka.apache.org/protocol), so a codec
+bug that's symmetric in the client would still be caught by the spec-shaped
+header/field layout assertions in tests/test_kafka.py.
+"""
+
+from __future__ import annotations
+
+import socketserver
+import struct
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from realtime_fraud_detection_tpu.stream.kafka import (
+    API_FETCH,
+    API_FIND_COORDINATOR,
+    API_LIST_OFFSETS,
+    API_METADATA,
+    API_OFFSET_COMMIT,
+    API_OFFSET_FETCH,
+    API_PRODUCE,
+    Reader,
+    Writer,
+    decode_message_set,
+    encode_message_set,
+)
+from realtime_fraud_detection_tpu.stream.topics import TOPIC_SPECS, TopicSpec
+
+__all__ = ["FakeKafkaServer"]
+
+
+class _Partition:
+    __slots__ = ("messages",)
+
+    def __init__(self) -> None:
+        # (key bytes|None, value bytes|None, timestamp_ms)
+        self.messages: List[Tuple[Optional[bytes], Optional[bytes], int]] = []
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self) -> None:
+        server: FakeKafkaServer = self.server.outer  # type: ignore[attr-defined]
+        sock = self.request
+        while True:
+            try:
+                header = self._recv_exact(sock, 4)
+            except ConnectionError:
+                return
+            if header is None:
+                return
+            (length,) = struct.unpack(">i", header)
+            frame = self._recv_exact(sock, length)
+            if frame is None:
+                return
+            r = Reader(frame)
+            api_key, api_version, corr = r.i16(), r.i16(), r.i32()
+            r.string()                             # client_id
+            try:
+                body = server.dispatch(api_key, api_version, r)
+            except Exception:  # noqa: BLE001 - kill the connection like a broker
+                return
+            resp = Writer().i32(corr).raw(body).done()
+            sock.sendall(struct.pack(">i", len(resp)) + resp)
+
+    @staticmethod
+    def _recv_exact(sock, n: int) -> Optional[bytes]:
+        buf = bytearray()
+        while len(buf) < n:
+            chunk = sock.recv(n - len(buf))
+            if not chunk:
+                return None
+            buf.extend(chunk)
+        return bytes(buf)
+
+
+class _TCPServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class FakeKafkaServer:
+    """Single-node Kafka-wire-protocol log over TCP (testing/dev only)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 topics: Sequence[TopicSpec] = TOPIC_SPECS,
+                 auto_create_partitions: int = 4):
+        self._log: Dict[str, List[_Partition]] = {}
+        self._committed: Dict[Tuple[str, str, int], int] = {}
+        self._lock = threading.Lock()
+        self._auto_partitions = auto_create_partitions
+        for t in topics:
+            self._log[t.name] = [_Partition() for _ in range(t.partitions)]
+        self._tcp = _TCPServer((host, port), _Handler)
+        self._tcp.outer = self  # type: ignore[attr-defined]
+        self._thread = threading.Thread(
+            target=self._tcp.serve_forever, name="fake-kafka", daemon=True)
+
+    def start(self) -> "FakeKafkaServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._tcp.shutdown()
+        self._tcp.server_close()
+
+    @property
+    def port(self) -> int:
+        return self._tcp.server_address[1]
+
+    @property
+    def host(self) -> str:
+        return self._tcp.server_address[0]
+
+    def _partitions(self, topic: str) -> List[_Partition]:
+        with self._lock:
+            parts = self._log.get(topic)
+            if parts is None:
+                parts = [_Partition() for _ in range(self._auto_partitions)]
+                self._log[topic] = parts
+            return parts
+
+    # -------------------------------------------------------------- dispatch
+    def dispatch(self, api_key: int, api_version: int, r: Reader) -> bytes:
+        if api_key == API_METADATA:
+            return self._metadata(r)
+        if api_key == API_PRODUCE:
+            return self._produce(r)
+        if api_key == API_FETCH:
+            return self._fetch(r)
+        if api_key == API_LIST_OFFSETS:
+            return self._list_offsets(r)
+        if api_key == API_FIND_COORDINATOR:
+            r.string()                             # group id — we coordinate
+            return (Writer().i16(0).i32(1).string(self.host)
+                    .i32(self.port).done())
+        if api_key == API_OFFSET_COMMIT:
+            return self._offset_commit(r)
+        if api_key == API_OFFSET_FETCH:
+            return self._offset_fetch(r)
+        raise NotImplementedError(f"api_key {api_key}")
+
+    def _metadata(self, r: Reader) -> bytes:
+        names = r.array(Reader.string)
+        if not names:                              # null/empty -> all topics
+            with self._lock:
+                names = sorted(self._log)
+        w = Writer()
+        w.array([(1, self.host, self.port, None)], lambda ww, b:
+                ww.i32(b[0]).string(b[1]).i32(b[2]).string(b[3]))
+        w.i32(1)                                   # controller id
+        w.i32(len(names))
+        for name in names:
+            parts = self._partitions(name)
+            w.i16(0).string(name).i8(0)
+            w.i32(len(parts))
+            for pid in range(len(parts)):
+                w.i16(0).i32(pid).i32(1)
+                w.array([1], Writer.i32).array([1], Writer.i32)
+        return w.done()
+
+    def _produce(self, r: Reader) -> bytes:
+        acks, _timeout = r.i16(), r.i32()
+        del acks                                   # single node: always "all"
+        results = []                               # (topic, part, base_offset)
+        for _ in range(r.i32()):
+            topic = r.string()
+            for _ in range(r.i32()):
+                part_id = r.i32()
+                record_set = r.bytes_() or b""
+                msgs = decode_message_set(record_set)
+                parts = self._partitions(topic)
+                part = parts[part_id]
+                with self._lock:
+                    base = len(part.messages)
+                    part.messages.extend(
+                        (key, value, ts) for _off, key, value, ts in msgs)
+                results.append((topic, part_id, base))
+        w = Writer()
+        by_topic: Dict[str, List[Tuple[int, int]]] = {}
+        for topic, pid, base in results:
+            by_topic.setdefault(topic, []).append((pid, base))
+        w.i32(len(by_topic))
+        for topic, parts in by_topic.items():
+            w.string(topic).i32(len(parts))
+            for pid, base in parts:
+                w.i32(pid).i16(0).i64(base).i64(-1)
+        w.i32(0)                                   # throttle_time_ms
+        return w.done()
+
+    def _fetch(self, r: Reader) -> bytes:
+        r.i32(); r.i32(); r.i32()                  # replica, max_wait, min_bytes
+        req = []
+        for _ in range(r.i32()):
+            topic = r.string()
+            for _ in range(r.i32()):
+                pid, offset, max_bytes = r.i32(), r.i64(), r.i32()
+                req.append((topic, pid, offset, max_bytes))
+        w = Writer()
+        w.i32(0)                                   # throttle_time_ms
+        by_topic: Dict[str, List[Tuple[int, int, int]]] = {}
+        for topic, pid, offset, max_bytes in req:
+            by_topic.setdefault(topic, []).append((pid, offset, max_bytes))
+        w.i32(len(by_topic))
+        for topic, parts in by_topic.items():
+            w.string(topic).i32(len(parts))
+            for pid, offset, max_bytes in parts:
+                part = self._partitions(topic)[pid]
+                with self._lock:
+                    msgs = part.messages[offset:]
+                    hw = len(part.messages)
+                # encode incrementally with absolute offsets and stop once
+                # max_bytes is exceeded (the overflowing message is
+                # truncated, Kafka-style) — never the whole partition tail
+                chunks: list = []
+                used = 0
+                for i, msg in enumerate(msgs):
+                    piece = encode_message_set([msg])
+                    piece = struct.pack(">q", offset + i) + piece[8:]
+                    chunks.append(piece)
+                    used += len(piece)
+                    if used > max_bytes:
+                        break
+                encoded = b"".join(chunks)
+                if len(encoded) > max_bytes:
+                    encoded = encoded[:max_bytes]
+                w.i32(pid).i16(0).i64(hw).bytes_(encoded)
+        return w.done()
+
+    def _list_offsets(self, r: Reader) -> bytes:
+        r.i32()                                    # replica id
+        req = []
+        for _ in range(r.i32()):
+            topic = r.string()
+            for _ in range(r.i32()):
+                pid, _ts = r.i32(), r.i64()
+                req.append((topic, pid))
+        w = Writer()
+        by_topic: Dict[str, List[int]] = {}
+        for topic, pid in req:
+            by_topic.setdefault(topic, []).append(pid)
+        w.i32(len(by_topic))
+        for topic, pids in by_topic.items():
+            w.string(topic).i32(len(pids))
+            for pid in pids:
+                part = self._partitions(topic)[pid]
+                with self._lock:
+                    end = len(part.messages)
+                w.i32(pid).i16(0).i64(-1).i64(end)
+        return w.done()
+
+    def _offset_commit(self, r: Reader) -> bytes:
+        group = r.string()
+        r.i32(); r.string(); r.i64()               # generation, member, retention
+        committed = []
+        for _ in range(r.i32()):
+            topic = r.string()
+            for _ in range(r.i32()):
+                pid, off = r.i32(), r.i64()
+                r.string()                         # metadata
+                with self._lock:
+                    key = (group, topic, pid)
+                    if off > self._committed.get(key, 0):
+                        self._committed[key] = off
+                committed.append((topic, pid))
+        w = Writer()
+        by_topic: Dict[str, List[int]] = {}
+        for topic, pid in committed:
+            by_topic.setdefault(topic, []).append(pid)
+        w.i32(len(by_topic))
+        for topic, pids in by_topic.items():
+            w.string(topic).i32(len(pids))
+            for pid in pids:
+                w.i32(pid).i16(0)
+        return w.done()
+
+    def _offset_fetch(self, r: Reader) -> bytes:
+        group = r.string()
+        req = []
+        for _ in range(r.i32()):
+            topic = r.string()
+            for pid in r.array(Reader.i32):
+                req.append((topic, pid))
+        w = Writer()
+        by_topic: Dict[str, List[int]] = {}
+        for topic, pid in req:
+            by_topic.setdefault(topic, []).append(pid)
+        w.i32(len(by_topic))
+        for topic, pids in by_topic.items():
+            w.string(topic).i32(len(pids))
+            for pid in pids:
+                with self._lock:
+                    off = self._committed.get((group, topic, pid), -1)
+                w.i32(pid).i64(off).string(None).i16(0)
+        return w.done()

@@ -1,0 +1,371 @@
+"""Networked, durable transport: a standalone TCP log broker + client.
+
+The reference's data backbone is an *external* Kafka cluster — the stream
+job, simulator, and serving tier are separate processes joined by brokers
+(docker-compose.yml, FraudDetectionJob.java:141-213). Round 1 of this
+framework only had the in-process ``InMemoryBroker``; this module makes the
+transport genuinely external without taking a client-library dependency:
+
+- ``BrokerServer`` — a TCP server exposing the partitioned-log operations
+  (produce / fetch / commit / committed / lag / end_offsets / create_topic)
+  over a length-prefixed JSON protocol. State is an ``InMemoryBroker`` plus
+  an optional write-ahead segment directory: every produce is appended to
+  ``<log_dir>/<topic>-<partition>.jsonl`` and fsync'd before the ack (the
+  acks=all analog of config/kafka/producer.properties), group offsets land
+  in ``<log_dir>/offsets.json`` on commit, and a restarting server replays
+  both — so the broker survives process death the way Kafka's log does.
+- ``NetBrokerClient`` — speaks the same protocol from any process and
+  implements the exact broker interface ``stream.transport.Consumer``
+  consumes (committed/partitions/read/commit/lag), so
+  ``StreamJob(broker=NetBrokerClient(...))`` runs unchanged against a
+  remote broker. One TCP connection, pipelined request/response framing,
+  thread-safe.
+
+The wire format is 4-byte big-endian length + JSON — deliberately boring:
+the contract (offsets, groups, keyed partitions, commit-after-fanout) is
+what's load-bearing, and the contract tests run identically against
+``InMemoryBroker`` and a live ``BrokerServer`` (tests/test_netbroker.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import socketserver
+import struct
+import threading
+import time
+from pathlib import Path
+from typing import Any, Dict, List, Mapping, Optional, Sequence
+
+from realtime_fraud_detection_tpu.stream.topics import TOPIC_SPECS, TopicSpec
+from realtime_fraud_detection_tpu.stream.transport import (
+    Consumer,
+    FaultInjector,
+    InMemoryBroker,
+    Record,
+)
+
+__all__ = ["BrokerServer", "NetBrokerClient"]
+
+_LEN = struct.Struct(">I")
+_MAX_FRAME = 64 * 1024 * 1024
+
+
+def _send_frame(sock: socket.socket, obj: Any) -> None:
+    payload = json.dumps(obj, separators=(",", ":")).encode()
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_frame(sock: socket.socket) -> Optional[Any]:
+    header = _recv_exact(sock, _LEN.size)
+    if header is None:
+        return None
+    (length,) = _LEN.unpack(header)
+    if length > _MAX_FRAME:
+        raise ValueError(f"frame of {length} bytes exceeds limit")
+    payload = _recv_exact(sock, length)
+    if payload is None:
+        return None
+    return json.loads(payload)
+
+
+# ---------------------------------------------------------------------------
+# server
+# ---------------------------------------------------------------------------
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self) -> None:  # one connection, many requests
+        server: BrokerServer = self.server.outer  # type: ignore[attr-defined]
+        sock = self.request
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        while True:
+            try:
+                req = _recv_frame(sock)
+            except (ConnectionError, ValueError, json.JSONDecodeError):
+                return
+            if req is None:
+                return
+            try:
+                resp = server.dispatch(req)
+            except Exception as e:  # noqa: BLE001 - fault isolation per request
+                resp = {"error": f"{type(e).__name__}: {e}"}
+            try:
+                _send_frame(sock, resp)
+            except ConnectionError:
+                return
+
+
+class _TCPServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class BrokerServer:
+    """Serve an (optionally durable) partitioned log over TCP."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 topics: Sequence[TopicSpec] = TOPIC_SPECS,
+                 log_dir: Optional[str] = None):
+        self.broker = InMemoryBroker(topics)
+        self.log_dir = Path(log_dir) if log_dir else None
+        self._seg_files: Dict[tuple, Any] = {}
+        self._io_lock = threading.Lock()
+        if self.log_dir is not None:
+            self.log_dir.mkdir(parents=True, exist_ok=True)
+            self._replay()
+        self._tcp = _TCPServer((host, port), _Handler)
+        self._tcp.outer = self  # type: ignore[attr-defined]
+        self._thread = threading.Thread(
+            target=self._tcp.serve_forever, name="broker-server", daemon=True)
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> "BrokerServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._tcp.shutdown()
+        self._tcp.server_close()
+        with self._io_lock:
+            for f in self._seg_files.values():
+                try:
+                    f.close()
+                except OSError:
+                    pass
+            self._seg_files.clear()
+
+    @property
+    def port(self) -> int:
+        return self._tcp.server_address[1]
+
+    # ----------------------------------------------------------- durability
+    def _segment(self, topic: str, partition: int):
+        key = (topic, partition)
+        f = self._seg_files.get(key)
+        if f is None:
+            path = self.log_dir / f"{topic}-{partition}.jsonl"
+            f = open(path, "a", encoding="utf-8")
+            self._seg_files[key] = f
+        return f
+
+    def _produce(self, topic: str, items: List[tuple]) -> List[Record]:
+        """Produce with WAL-first durability: partition is chosen, the WAL
+        line is written + fsync'd, and only then is the record published to
+        the in-memory log (one fsync per produce call — acks=all). A WAL
+        write failure therefore errors the produce *before* any consumer
+        could see the record; ``_io_lock`` serializes durable produces so
+        WAL line order always matches log offset order per partition.
+        ``items``: [(key, value, timestamp|None)].
+        """
+        b = self.broker
+        if self.log_dir is None:
+            return [b.produce(topic, v, k, ts) for k, v, ts in items]
+        with self._io_lock:
+            planned = [
+                (b.select_partition(topic, k), k, v,
+                 ts if ts is not None else time.time())
+                for k, v, ts in items
+            ]
+            touched = set()
+            for part, k, v, ts in planned:
+                f = self._segment(topic, part)
+                f.write(json.dumps({"k": k, "v": v, "ts": ts},
+                                   separators=(",", ":")) + "\n")
+                touched.add(f)
+            for f in touched:
+                f.flush()
+                os.fsync(f.fileno())
+            return [b.append(topic, part, v, k, ts)
+                    for part, k, v, ts in planned]
+
+    def _persist_offsets(self) -> None:
+        if self.log_dir is None:
+            return
+        with self._io_lock:
+            snap = {
+                f"{g}\x00{t}\x00{p}": off
+                for (g, t, p), off in self.broker._committed.items()
+            }
+            tmp = self.log_dir / "offsets.json.tmp"
+            tmp.write_text(json.dumps(snap))
+            tmp.replace(self.log_dir / "offsets.json")
+
+    def _replay(self) -> None:
+        for path in sorted(self.log_dir.glob("*-*.jsonl")):
+            topic, _, part_s = path.stem.rpartition("-")
+            try:
+                part = int(part_s)
+            except ValueError:
+                continue
+            logs = self.broker._logs(topic)
+            if part >= len(logs):
+                self.broker._topics[topic].extend(
+                    type(logs[0])() for _ in range(part + 1 - len(logs)))
+            log = self.broker._logs(topic)[part]
+            with open(path, encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    d = json.loads(line)
+                    log.records.append(Record(
+                        topic, part, len(log.records), d.get("k"),
+                        d.get("v"), d.get("ts", 0.0)))
+        off_path = self.log_dir / "offsets.json"
+        if off_path.exists():
+            for key, off in json.loads(off_path.read_text()).items():
+                g, t, p = key.split("\x00")
+                self.broker._committed[(g, t, int(p))] = int(off)
+
+    # ------------------------------------------------------------- dispatch
+    def dispatch(self, req: Mapping[str, Any]) -> Dict[str, Any]:
+        op = req.get("op")
+        b = self.broker
+        if op == "produce":
+            rec = self._produce(req["topic"], [(
+                req.get("key"), req["value"], req.get("timestamp"))])[0]
+            return {"partition": rec.partition, "offset": rec.offset}
+        if op == "produce_batch":
+            recs = self._produce(req["topic"], [
+                (item.get("k"), item["v"], None) for item in req["records"]])
+            return {"n": len(recs)}
+        if op == "fetch":
+            recs = b.read(req["topic"], req["partition"], req["offset"],
+                          req["max_records"])
+            return {"records": [
+                {"p": r.partition, "o": r.offset, "k": r.key, "v": r.value,
+                 "ts": r.timestamp} for r in recs]}
+        if op == "commit":
+            offsets = {}
+            for key, off in req["offsets"].items():
+                t, _, p = key.rpartition(":")
+                offsets[(t, int(p))] = int(off)
+            b.commit(req["group"], offsets)
+            self._persist_offsets()
+            return {}
+        if op == "committed":
+            return {"offset": b.committed(req["group"], req["topic"],
+                                          req["partition"])}
+        if op == "partitions":
+            return {"n": b.partitions(req["topic"])}
+        if op == "end_offsets":
+            return {"ends": b.end_offsets(req["topic"])}
+        if op == "lag":
+            return {"lag": b.lag(req["group"], req["topic"])}
+        if op == "create_topic":
+            b.create_topic(req["name"], req["partitions"])
+            return {}
+        if op == "ping":
+            return {"pong": True}
+        raise ValueError(f"unknown op {op!r}")
+
+
+# ---------------------------------------------------------------------------
+# client
+# ---------------------------------------------------------------------------
+
+
+class NetBrokerClient:
+    """Broker-interface client over one pipelined TCP connection.
+
+    Implements the five methods ``transport.Consumer`` needs (committed /
+    partitions / read / commit / lag) plus the producer surface, so every
+    component that takes an ``InMemoryBroker`` takes one of these.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 9092,
+                 timeout_s: float = 30.0):
+        self._sock = socket.create_connection((host, port), timeout=timeout_s)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._lock = threading.Lock()
+        self._part_cache: Dict[str, int] = {}
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def _call(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        with self._lock:
+            _send_frame(self._sock, req)
+            resp = _recv_frame(self._sock)
+        if resp is None:
+            raise ConnectionError("broker closed the connection")
+        if "error" in resp:
+            raise RuntimeError(f"broker error: {resp['error']}")
+        return resp
+
+    # ------------------------------------------------------------- produce
+    def produce(self, topic: str, value: Any, key: Optional[str] = None,
+                timestamp: Optional[float] = None) -> Record:
+        r = self._call({"op": "produce", "topic": topic, "value": value,
+                        "key": key, "timestamp": timestamp})
+        return Record(topic, r["partition"], r["offset"], key, value,
+                      timestamp or 0.0)
+
+    def produce_batch(self, topic: str, values, key_fn=None) -> int:
+        items = [{"v": v, "k": key_fn(v) if key_fn else None} for v in values]
+        if not items:
+            return 0
+        return self._call({"op": "produce_batch", "topic": topic,
+                           "records": items})["n"]
+
+    # ------------------------------------------------------------- consume
+    def consumer(self, topics: Sequence[str], group_id: str,
+                 faults: Optional[FaultInjector] = None) -> Consumer:
+        return Consumer(self, list(topics), group_id, faults)
+
+    def read(self, topic: str, partition: int, start: int,
+             limit: int) -> List[Record]:
+        resp = self._call({"op": "fetch", "topic": topic,
+                           "partition": partition, "offset": start,
+                           "max_records": limit})
+        return [
+            Record(topic, d["p"], d["o"], d.get("k"), d.get("v"),
+                   d.get("ts", 0.0))
+            for d in resp["records"]
+        ]
+
+    # ------------------------------------------------------------- offsets
+    def committed(self, group: str, topic: str, partition: int) -> int:
+        return self._call({"op": "committed", "group": group, "topic": topic,
+                           "partition": partition})["offset"]
+
+    def commit(self, group: str, offsets: Mapping[tuple, int]) -> None:
+        wire = {f"{t}:{p}": off for (t, p), off in offsets.items()}
+        self._call({"op": "commit", "group": group, "offsets": wire})
+
+    def partitions(self, topic: str) -> int:
+        n = self._part_cache.get(topic)
+        if n is None:
+            n = self._call({"op": "partitions", "topic": topic})["n"]
+            self._part_cache[topic] = n
+        return n
+
+    def end_offsets(self, topic: str) -> List[int]:
+        return self._call({"op": "end_offsets", "topic": topic})["ends"]
+
+    def lag(self, group: str, topic: str) -> int:
+        return self._call({"op": "lag", "group": group, "topic": topic})["lag"]
+
+    def create_topic(self, name: str, partitions: int) -> None:
+        self._part_cache.pop(name, None)
+        self._call({"op": "create_topic", "name": name,
+                    "partitions": partitions})
+
+    def ping(self) -> bool:
+        return bool(self._call({"op": "ping"}).get("pong"))

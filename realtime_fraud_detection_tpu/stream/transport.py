@@ -9,9 +9,11 @@ behind one interface:
   log entirely in process. This is the test/dev/bench transport and the
   SURVEY.md §4 "fake in-process transport" testing strategy. Supports
   deterministic fault injection (drop/dup/delay) for failure-path tests.
-- ``KafkaTransport`` — thin adapter over kafka-python, import-gated because
-  the client library is not present in this image; the interface is the
-  contract, so swapping it in is a deployment choice, not a rewrite.
+- ``KafkaBroker`` (stream/kafka.py) — a real Kafka wire-protocol client
+  (no library dependency) behind the same interface; ``NetBrokerClient``
+  (stream/netbroker.py) — the framework's own networked durable broker.
+  The interface is the contract, so transports are a deployment choice,
+  not a rewrite (contract suite: tests/test_netbroker.py, test_kafka.py).
 
 Offset semantics (the exactly-once story, SURVEY.md §5.4): consumers read
 from their group's committed offset; commit happens only after downstream
@@ -111,23 +113,34 @@ class InMemoryBroker:
         return len(self._logs(topic))
 
     # -------------------------------------------------------------- produce
-    def produce(self, topic: str, value: Any, key: Optional[str] = None,
-                timestamp: Optional[float] = None) -> Record:
-        """Append one record; partition chosen by key hash (Kafka semantics:
-        same key -> same partition -> per-key ordering)."""
+    def select_partition(self, topic: str, key: Optional[str]) -> int:
+        """Key hash (same key -> same partition -> per-key ordering), or
+        round-robin for unkeyed records, like Kafka's default partitioner."""
         logs = self._logs(topic)
         if key is not None:
-            part = hash(key) % len(logs)
-        else:  # unkeyed: round-robin, like Kafka's default partitioner
-            with self._lock:
-                part = self._rr.get(topic, 0) % len(logs)
-                self._rr[topic] = part + 1
-        log = logs[part]
+            return hash(key) % len(logs)
+        with self._lock:
+            part = self._rr.get(topic, 0) % len(logs)
+            self._rr[topic] = part + 1
+        return part
+
+    def append(self, topic: str, partition: int, value: Any,
+               key: Optional[str] = None,
+               timestamp: Optional[float] = None) -> Record:
+        """Append to a specific partition (produce = select + append; split
+        so a durable front-end can write its WAL between the two)."""
+        log = self._logs(topic)[partition]
         with log.lock:
-            rec = Record(topic, part, len(log.records), key, value,
+            rec = Record(topic, partition, len(log.records), key, value,
                          timestamp if timestamp is not None else time.time())
             log.records.append(rec)
         return rec
+
+    def produce(self, topic: str, value: Any, key: Optional[str] = None,
+                timestamp: Optional[float] = None) -> Record:
+        """Append one record; partition chosen by key hash."""
+        return self.append(topic, self.select_partition(topic, key), value,
+                           key, timestamp)
 
     def produce_batch(self, topic: str, values: Iterable[Any],
                       key_fn: Optional[Callable[[Any], str]] = None) -> int:
@@ -214,8 +227,18 @@ class Consumer:
                 out.extend(recs)
         return out
 
-    def commit(self) -> None:
-        self.broker.commit(self.group_id, dict(self._position))
+    def commit(self, offsets: Optional[Dict[tuple, int]] = None) -> None:
+        """Commit positions. With ``offsets`` (a ``snapshot_positions()``
+        result), commit exactly those — the pipelined job snapshots positions
+        at dispatch time so a batch still in flight on the device is never
+        committed past by a later poll."""
+        self.broker.commit(
+            self.group_id,
+            dict(self._position) if offsets is None else offsets)
+
+    def snapshot_positions(self) -> Dict[tuple, int]:
+        """Copy of current read positions keyed (topic, partition)."""
+        return dict(self._position)
 
     def positions(self) -> Dict[str, int]:
         """JSON-safe snapshot of current read positions
@@ -226,17 +249,12 @@ class Consumer:
         return sum(self.broker.lag(self.group_id, t) for t in self.topics)
 
 
-class KafkaTransport:
-    """Adapter to a real Kafka cluster (import-gated; kafka-python is not in
-    this image). Mirrors the reference producer config: idempotent, acks=all,
-    lz4 (config/kafka/producer.properties)."""
+def KafkaTransport(bootstrap_servers: str = "localhost:9092", **kwargs):
+    """Real Kafka adapter: the framework's own wire-protocol client
+    (stream/kafka.py — no client-library dependency). Returns a
+    ``KafkaBroker`` implementing this module's broker interface, so
+    ``StreamJob(broker=KafkaTransport(...))`` runs unchanged against a
+    cluster. Kept as a factory here for backward-compatible imports."""
+    from realtime_fraud_detection_tpu.stream.kafka import KafkaBroker
 
-    def __init__(self, bootstrap_servers: str = "localhost:9092"):
-        try:
-            import kafka  # noqa: F401
-        except ImportError as e:  # pragma: no cover
-            raise ImportError(
-                "kafka-python is not installed in this environment; use "
-                "InMemoryBroker, or install kafka-python for a real cluster"
-            ) from e
-        self.bootstrap_servers = bootstrap_servers  # pragma: no cover
+    return KafkaBroker(bootstrap=bootstrap_servers, **kwargs)

@@ -1,0 +1,131 @@
+"""Transport contract suite: InMemoryBroker vs networked BrokerServer.
+
+The same assertions run against both transports — the contract (keyed
+partition ordering, committed offsets, group replay, snapshot commits, lag)
+is what StreamJob depends on, so any future backend (Kafka adapter included)
+must pass this file unchanged.
+"""
+
+import pytest
+
+from realtime_fraud_detection_tpu.stream import InMemoryBroker
+from realtime_fraud_detection_tpu.stream import topics as T
+from realtime_fraud_detection_tpu.stream.netbroker import (
+    BrokerServer,
+    NetBrokerClient,
+)
+
+
+@pytest.fixture(params=["memory", "net"])
+def any_broker(request, tmp_path):
+    if request.param == "memory":
+        yield InMemoryBroker()
+        return
+    server = BrokerServer(port=0).start()
+    client = NetBrokerClient(port=server.port)
+    try:
+        yield client
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_contract_keyed_ordering(any_broker):
+    b = any_broker
+    for i in range(20):
+        b.produce(T.TRANSACTIONS, {"n": i}, key="user_7")
+    c = b.consumer([T.TRANSACTIONS], "g1")
+    recs = c.poll(100)
+    assert [r.value["n"] for r in recs] == list(range(20))
+    assert len({r.partition for r in recs}) == 1
+
+
+def test_contract_commit_replay(any_broker):
+    b = any_broker
+    for i in range(10):
+        b.produce(T.TRANSACTIONS, {"n": i}, key="k")
+    c = b.consumer([T.TRANSACTIONS], "g")
+    assert len(c.poll(4)) == 4
+    # crash without commit: a new consumer in the group re-reads everything
+    c2 = b.consumer([T.TRANSACTIONS], "g")
+    assert len(c2.poll(100)) == 10
+    c2.commit()
+    assert b.consumer([T.TRANSACTIONS], "g").poll(100) == []
+    assert b.lag("g", T.TRANSACTIONS) == 0
+
+
+def test_contract_snapshot_commit(any_broker):
+    """commit(offsets) covers exactly the snapshot, not later polls."""
+    b = any_broker
+    for i in range(10):
+        b.produce(T.TRANSACTIONS, {"n": i}, key="k")
+    c = b.consumer([T.TRANSACTIONS], "g")
+    first = c.poll(6)
+    snap = c.snapshot_positions()
+    second = c.poll(10)
+    assert len(first) == 6 and len(second) == 4
+    c.commit(snap)
+    assert b.lag("g", T.TRANSACTIONS) == 4
+
+
+def test_contract_produce_batch_and_end_offsets(any_broker):
+    b = any_broker
+    n = b.produce_batch(T.TRANSACTIONS, [{"n": i} for i in range(24)],
+                        key_fn=lambda v: str(v["n"] % 5))
+    assert n == 24
+    assert sum(b.end_offsets(T.TRANSACTIONS)) == 24
+
+
+def test_netbroker_durability(tmp_path):
+    """Kill the server; a fresh server over the same log_dir serves the
+    records and committed offsets (the Kafka-log durability analog)."""
+    log_dir = tmp_path / "wal"
+    server = BrokerServer(port=0, log_dir=str(log_dir)).start()
+    client = NetBrokerClient(port=server.port)
+    client.produce_batch(T.TRANSACTIONS, [{"n": i} for i in range(12)],
+                         key_fn=lambda v: str(v["n"] % 3))
+    c = client.consumer([T.TRANSACTIONS], "g")
+    got = c.poll(7)
+    # commit exactly what we read so far
+    c.commit()
+    client.close()
+    server.stop()
+
+    server2 = BrokerServer(port=0, log_dir=str(log_dir)).start()
+    client2 = NetBrokerClient(port=server2.port)
+    try:
+        assert sum(client2.end_offsets(T.TRANSACTIONS)) == 12
+        c2 = client2.consumer([T.TRANSACTIONS], "g")
+        rest = c2.poll(100)
+        ids_before = {(r.partition, r.offset) for r in got}
+        ids_after = {(r.partition, r.offset) for r in rest}
+        assert not ids_before & ids_after          # no double delivery
+        assert len(got) + len(rest) == 12          # nothing lost
+    finally:
+        client2.close()
+        server2.stop()
+
+
+def test_stream_job_over_netbroker():
+    """The full scoring job runs unchanged against the networked broker."""
+    from realtime_fraud_detection_tpu.scoring import FraudScorer, ScorerConfig
+    from realtime_fraud_detection_tpu.sim.simulator import TransactionGenerator
+    from realtime_fraud_detection_tpu.stream import JobConfig, StreamJob
+
+    server = BrokerServer(port=0).start()
+    client = NetBrokerClient(port=server.port)
+    try:
+        gen = TransactionGenerator(num_users=30, num_merchants=12, seed=23)
+        scorer = FraudScorer(scorer_config=ScorerConfig(text_len=32))
+        scorer.seed_profiles(gen.users.profiles(), gen.merchants.profiles())
+        job = StreamJob(client, scorer, JobConfig(max_batch=16,
+                                                  max_delay_ms=1.0))
+        client.produce_batch(T.TRANSACTIONS, gen.generate_batch(40),
+                             key_fn=lambda r: str(r["user_id"]))
+        assert job.run_until_drained(now=1000.0) == 40
+        preds = client.consumer([T.PREDICTIONS], "check").poll(1000)
+        assert len(preds) == 40
+        assert client.lag(job.config.group_id, T.TRANSACTIONS) == 0
+    finally:
+        client.close()
+        server.stop()

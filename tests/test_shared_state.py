@@ -1,0 +1,183 @@
+"""Shared state tier: RESP codec/server units + store semantics + the
+two-replicas-one-server story the k8s HPA scale-out depends on."""
+
+import threading
+
+import pytest
+
+from realtime_fraud_detection_tpu.state import (
+    MiniRedisServer,
+    RespClient,
+    SharedAggregationStore,
+    SharedProfileStore,
+    SharedTransactionCache,
+    SharedVelocityStore,
+)
+
+
+@pytest.fixture(scope="module")
+def server():
+    s = MiniRedisServer().start()
+    yield s
+    s.stop()
+
+
+@pytest.fixture()
+def client(server):
+    c = RespClient(port=server.port)
+    c.flushdb()
+    yield c
+    c.close()
+
+
+# ------------------------------------------------------------------ protocol
+
+
+def test_resp_basic_commands(client):
+    c = client
+    assert c.ping()
+    c.set("k", "v")
+    assert c.get("k") == b"v"
+    assert c.exists("k")
+    assert c.delete("k") == 1
+    assert c.get("k") is None
+    assert c.incr("ctr") == 1 and c.incr("ctr") == 2
+    assert c.incrbyfloat("f", 1.5) == 1.5
+    assert c.incrbyfloat("f", 2.25) == 3.75
+
+
+def test_resp_hash_and_list(client):
+    c = client
+    c.hset("h", "a", "1", "b", "2")
+    assert c.hget("h", "a") == b"1"
+    assert c.hgetall("h") == {"a": b"1", "b": b"2"}
+    assert c.hincrby("h", "n", 5) == 5
+    assert c.hincrbyfloat("h", "x", 0.5) == 0.5
+    c.lpush("l", "c", "b", "a")
+    assert c.lrange("l", 0, -1) == [b"a", b"b", b"c"]
+    c.ltrim("l", 0, 1)
+    assert c.llen("l") == 2
+
+
+def test_resp_ttl_expiry(client):
+    c = client
+    c.set("t", "v", ex=0.05)
+    assert c.get("t") == b"v"
+    import time
+
+    time.sleep(0.08)
+    assert c.get("t") is None
+
+
+def test_resp_wrongtype_errors(client):
+    c = client
+    c.set("s", "v")
+    from realtime_fraud_detection_tpu.state.resp import RespError
+
+    with pytest.raises(RespError, match="WRONGTYPE"):
+        c.hgetall("s")
+
+
+def test_resp_unicode_binary_safe(client):
+    c = client
+    c.set("u", "caffè ☕")
+    assert c.get("u").decode() == "caffè ☕"
+    c.set("b", b"\x00\xff\r\n$5")
+    assert c.get("b") == b"\x00\xff\r\n$5"
+
+
+# -------------------------------------------------------------------- stores
+
+
+def test_shared_profile_round_trip(client):
+    store = SharedProfileStore(client)
+    prof = {"risk_score": 0.4, "kyc_status": "verified",
+            "behavioral_patterns": {"weekend_activity": 0.7}}
+    store.put_user("u1", prof)
+    assert store.get_user("u1") == prof
+    assert store.get_user("nope") is None
+
+
+def test_shared_velocity_windows(client):
+    v = SharedVelocityStore(client)
+    v.update("u1", 100.0, now=1000.0)
+    v.update("u1", 50.0, now=1001.0)
+    got = v.get("u1", "5min")
+    assert got["count"] == 2 and got["amount"] == 150.0
+    assert set(v.get_all("u1")) == {"5min", "1hour", "24hour"}
+
+
+def test_shared_txn_cache_lists(client):
+    cache = SharedTransactionCache(client, user_list_len=3)
+    for i in range(5):
+        cache.cache_transaction(
+            {"transaction_id": f"t{i}", "user_id": "u", "merchant_id": "m"})
+    assert cache.get_transaction("t4")["transaction_id"] == "t4"
+    assert cache.get_user_transactions("u") == ["t4", "t3", "t2"]  # last 3
+    cache.store_features("t4", [1.0, 2.0])
+    assert cache.get_features("t4") == [1.0, 2.0]
+
+
+def test_shared_aggregations(client):
+    agg = SharedAggregationStore(client)
+    agg.record({"merchant_id": "m", "amount": 10.0, "is_fraud": True,
+                "fraud_score": 0.9, "timestamp_ms": 3_600_000.0})
+    agg.record({"merchant_id": "m", "amount": 30.0, "is_fraud": False,
+                "fraud_score": 0.1, "timestamp_ms": 3_700_000.0})
+    got = agg.get("hourly:1")
+    assert got["total_count"] == 2
+    assert got["fraud_rate"] == 0.5
+    assert got["avg_amount"] == 20.0
+
+
+def test_concurrent_replicas_no_lost_updates(server):
+    """Two 'replicas' (connections) increment the same user's velocity
+    concurrently: atomic HINCRBY must not lose a single update — the
+    failure mode the reference's GET-then-SET pattern has."""
+    c0 = RespClient(port=server.port)
+    c0.flushdb()
+    n_each = 200
+
+    def replica():
+        c = RespClient(port=server.port)
+        v = SharedVelocityStore(c)
+        for _ in range(n_each):
+            v.update("hot_user", 1.0, now=1000.0)
+        c.close()
+
+    threads = [threading.Thread(target=replica) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    v = SharedVelocityStore(c0)
+    got = v.get("hot_user", "1hour")
+    assert got["count"] == 4 * n_each
+    assert got["amount"] == 4.0 * n_each
+    c0.close()
+
+
+def test_scorer_runs_on_shared_stores(server):
+    """FraudScorer wired to the shared tier scores and write-backs through
+    the RESP server; a second scorer sees the first one's state."""
+    from realtime_fraud_detection_tpu.scoring import FraudScorer, ScorerConfig
+    from realtime_fraud_detection_tpu.sim.simulator import TransactionGenerator
+
+    c1, c2 = RespClient(port=server.port), RespClient(port=server.port)
+    c1.flushdb()
+    gen = TransactionGenerator(num_users=20, num_merchants=10, seed=31)
+    s1 = FraudScorer(scorer_config=ScorerConfig(text_len=32), state_client=c1)
+    s1.seed_profiles(gen.users.profiles(), gen.merchants.profiles())
+    records = gen.generate_batch(8)
+    results = s1.score_batch(records, now=1000.0)
+    assert len(results) == 8
+
+    s2 = FraudScorer(scorer_config=ScorerConfig(text_len=32), state_client=c2)
+    # replica 2 sees replica 1's profiles, velocity, and txn cache
+    uid = str(records[0]["user_id"])
+    assert s2.profiles.get_user(uid) is not None
+    assert s2.velocity.get_all(uid)["24hour"]["count"] >= 1
+    tid = str(records[0]["transaction_id"])
+    assert s2.txn_cache.get_transaction(tid) is not None
+    c1.close()
+    c2.close()

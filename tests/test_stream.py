@@ -165,3 +165,51 @@ def test_enrichment_applies_with_analytics_only(job_env):
     # nothing on the enriched topic, but analytics saw blended scores
     assert not broker.consumer([T.ENRICHED], "c").poll(100)
     assert job.analytics.stats()["user_velocity"]["watermark"] > 0
+
+
+def test_pipelined_dispatch_dedupes_in_flight():
+    """A duplicate transaction_id in batch N+1 while batch N is still in
+    flight (dispatched, not completed) must be skipped — the pipelined
+    dedupe checks in-flight ids, not just the txn cache."""
+    gen = TransactionGenerator(num_users=20, num_merchants=10, seed=17)
+    broker = InMemoryBroker()
+    scorer = FraudScorer(scorer_config=ScorerConfig(text_len=32))
+    scorer.seed_profiles(gen.users.profiles(), gen.merchants.profiles())
+    job = StreamJob(broker, scorer, JobConfig(max_batch=8))
+    records = gen.generate_batch(8)
+    broker.produce_batch(T.TRANSACTIONS, records,
+                         key_fn=lambda r: str(r["user_id"]))
+    batch1 = job.assembler.next_batch(block=True, timeout_s=1.0)
+    ctx1 = job.dispatch_batch(batch1, now=1000.0)
+    # redeliver the same records while ctx1 is in flight
+    broker.produce_batch(T.TRANSACTIONS, records,
+                         key_fn=lambda r: str(r["user_id"]))
+    batch2 = job.assembler.next_batch(block=True, timeout_s=1.0)
+    ctx2 = job.dispatch_batch(batch2, now=1000.5)
+    assert job.counters["duplicates_skipped"] == 8
+    assert len(job.complete_batch(ctx1)) == 8
+    assert job.complete_batch(ctx2) == []
+    assert job.counters["scored"] == 8
+    # all offsets committed (the empty ctx still commits its snapshot)
+    assert broker.lag(job.config.group_id, T.TRANSACTIONS) == 0
+
+
+def test_pipelined_commit_covers_only_dispatched_offsets():
+    """Offsets snapshotted at dispatch: completing batch N must not commit
+    past records polled for a later, still-uncommitted batch."""
+    gen = TransactionGenerator(num_users=20, num_merchants=10, seed=19)
+    broker = InMemoryBroker()
+    scorer = FraudScorer(scorer_config=ScorerConfig(text_len=32))
+    scorer.seed_profiles(gen.users.profiles(), gen.merchants.profiles())
+    job = StreamJob(broker, scorer, JobConfig(max_batch=8))
+    broker.produce_batch(T.TRANSACTIONS, gen.generate_batch(16),
+                         key_fn=lambda r: str(r["user_id"]))
+    batch1 = job.assembler.next_batch(block=True, timeout_s=1.0)
+    ctx1 = job.dispatch_batch(batch1, now=1000.0)
+    batch2 = job.assembler.next_batch(block=True, timeout_s=1.0)
+    assert batch2
+    job.dispatch_batch(batch2, now=1000.1)  # in flight, never completed
+    job.complete_batch(ctx1)
+    # only batch1's records are covered by the commit: batch2 replays
+    lag = broker.lag(job.config.group_id, T.TRANSACTIONS)
+    assert lag == len(batch2)
