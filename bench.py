@@ -1,10 +1,22 @@
 """Benchmark: the 5 BASELINE.json configs + latency decomposition, one chip.
 
-Prints ONE JSON line. Headline metric: full-ensemble scoring throughput
-(transactions/sec/chip, batch=256, pipelined dispatch — how the production
-StreamJob/DoubleBufferedScorer paths run). ``vs_baseline`` compares against
-the reference's claimed 15,000 TPS sustained for its entire multi-node
-cluster (reference README.md:201); our number is ONE chip.
+Prints ONE JSON line and ALWAYS exits 0 — even when the TPU relay is wedged.
+
+Architecture (VERDICT r2 item 1): the parent process is a jax-free
+orchestrator. It probes TPU availability in a short-timeout subprocess
+(backend init on this host can HANG, not just raise — the axon PJRT plugin
+wedges inside ``jax.devices()``), then runs the actual bench as
+``bench.py --inner`` in a child. If the TPU probe or the TPU bench fails or
+times out, it re-runs the child on a clean CPU backend (``PALLAS_AXON_POOL_IPS``
+removed so the sitecustomize TPU registration never happens,
+``JAX_PLATFORMS=cpu``) and still emits the one JSON line, with
+``"device": "cpu-fallback"`` and an ``"error"`` field naming the TPU failure.
+
+Headline metric: full-ensemble scoring throughput (transactions/sec/chip,
+batch=256, pipelined dispatch — how the production StreamJob /
+DoubleBufferedScorer paths run). ``vs_baseline`` compares against the
+reference's claimed 15,000 TPS sustained for its entire multi-node cluster
+(reference README.md:201); our number is ONE chip.
 
 Also reported:
 - ``configs``: per-config txn/s/chip for each BASELINE.json config —
@@ -14,11 +26,13 @@ Also reported:
 - ``latency``: p50/p99 per batch size for the full ensemble, measured two
   ways: ``e2e`` (host-resident args, includes H2D + dispatch round-trip —
   what a caller over the axon tunnel sees) and ``device`` (device-resident
-  args, isolates chip compute). The gap IS the tunnel/transfer cost — the
-  decomposition VERDICT r1 asked for (assemble is measured separately).
+  args, isolates chip compute). The gap IS the tunnel/transfer cost.
 - ``pallas``: DistilBERT-base branch with the Pallas flash-attention kernel
   vs plain XLA attention on this chip; the faster one is used for the
   headline ensemble program.
+- ``mfu``: analytic matmul FLOPs of the fused batch=256 ensemble program
+  (BERT + LSTM + GNN; tree gathers contribute ~0 FLOPs) divided by
+  device-resident p50 time and the chip's bf16 peak (VERDICT r2 item 8).
 - ``e2e_stream``: StreamJob soak over the in-memory broker (assemble +
   device + fan-out + commit, two-deep pipelined) — the whole-framework
   number, not just the device program.
@@ -32,14 +46,21 @@ configs.
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
 
-import jax
-import jax.numpy as jnp
 import numpy as np
 
 _T0 = time.monotonic()
+
+BASELINE_TPS = 15_000.0  # reference README.md:201 (whole cluster)
+METRIC_NAME = (
+    "full-ensemble scoring throughput (5 branches, batch=256, pipelined)"
+)
+# Per-chip bf16 peak for MFU accounting, by platform substring.
+_PEAK_BF16_TFLOPS = {"v5e": 197.0, "v5p": 459.0, "v6e": 918.0, "v4": 275.0}
 
 
 def _log(msg: str) -> None:
@@ -47,6 +68,100 @@ def _log(msg: str) -> None:
     print(f"[bench +{time.monotonic() - _T0:7.1f}s] {msg}",
           file=sys.stderr, flush=True)
 
+
+# --------------------------------------------------------------------------
+# Orchestrator (jax-free: must never initialize a backend in this process)
+# --------------------------------------------------------------------------
+
+def _probe_tpu(timeout_s: float = 150.0) -> tuple[str | None, str | None]:
+    """(platform, error): init the backend in a throwaway subprocess."""
+    code = ("import jax; d = jax.devices(); "
+            "print('PLATFORM=' + d[0].platform, flush=True)")
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=timeout_s, cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"backend init hang (probe timeout {timeout_s:.0f}s)"
+    if proc.returncode != 0:
+        tail = (proc.stderr or proc.stdout or "").strip().splitlines()
+        return None, (tail[-1][:300] if tail else f"probe rc={proc.returncode}")
+    for line in proc.stdout.splitlines():
+        if line.startswith("PLATFORM="):
+            return line.split("=", 1)[1], None
+    return None, "probe produced no PLATFORM line"
+
+
+def _run_inner(env: dict, timeout_s: float) -> dict:
+    """Run ``bench.py --inner``; return the parsed JSON result line.
+
+    stderr is inherited so per-stage progress streams to the driver log
+    even if this parent is later killed.
+    """
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--inner"],
+        stdout=subprocess.PIPE, text=True, env=env, timeout=timeout_s,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    for line in reversed((proc.stdout or "").splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                parsed = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(parsed, dict) and "metric" in parsed:
+                return parsed
+    raise RuntimeError(f"inner bench rc={proc.returncode}, no JSON line")
+
+
+def _cpu_env() -> dict:
+    env = dict(os.environ)
+    # Gate for the sitecustomize axon/TPU plugin registration: without it a
+    # fresh interpreter never touches the (possibly wedged) relay.
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["RTFD_BENCH_DEVICE_LABEL"] = "cpu-fallback"
+    return env
+
+
+def orchestrate() -> None:
+    errors: list[str] = []
+    result: dict | None = None
+
+    platform, err = _probe_tpu()
+    if platform and platform != "cpu":
+        _log(f"TPU probe ok (platform={platform}); running bench on it")
+        try:
+            result = _run_inner(dict(os.environ), timeout_s=1500.0)
+        except Exception as e:  # noqa: BLE001 — must always emit JSON
+            errors.append(f"tpu bench failed: {type(e).__name__}: {e}"[:300])
+            _log(errors[-1])
+    else:
+        errors.append(f"tpu unavailable: {err}")
+        _log(errors[-1])
+
+    if result is None:
+        _log("falling back to clean CPU backend")
+        try:
+            result = _run_inner(_cpu_env(), timeout_s=900.0)
+        except Exception as e:  # noqa: BLE001
+            errors.append(f"cpu fallback failed: {type(e).__name__}: {e}"[:300])
+            _log(errors[-1])
+
+    if result is None:
+        result = {"metric": METRIC_NAME, "value": 0.0, "unit": "txn/s/chip",
+                  "vs_baseline": 0.0, "device": "none"}
+    if errors:
+        result["error"] = "; ".join(errors)[:600]
+    print(json.dumps(result), flush=True)
+    sys.exit(0)
+
+
+# --------------------------------------------------------------------------
+# Inner bench (the only process that imports jax)
+# --------------------------------------------------------------------------
 
 def _percentiles(times_s) -> dict:
     ms = np.asarray(times_s) * 1e3
@@ -59,6 +174,8 @@ def _percentiles(times_s) -> dict:
 
 def _time_blocked(fn, iters: int) -> list:
     """Per-call latency: block on each call's result before the next."""
+    import jax
+
     out = fn()
     jax.block_until_ready(out)           # warm (compile already done)
     times = []
@@ -71,6 +188,8 @@ def _time_blocked(fn, iters: int) -> list:
 
 def _throughput_pipelined(fn, batch_size: int, iters: int) -> float:
     """txn/s with async dispatch: device stays fed, block once at the end."""
+    import jax
+
     jax.block_until_ready(fn())
     t0 = time.perf_counter()
     outs = [fn() for _ in range(iters)]
@@ -78,7 +197,27 @@ def _throughput_pipelined(fn, batch_size: int, iters: int) -> float:
     return batch_size * iters / (time.perf_counter() - t0)
 
 
-def main() -> None:
+def _ensemble_matmul_flops(bert_config, sc, batch: int) -> float:
+    """Analytic matmul FLOPs per fused-ensemble call (counting 2*M*N*K).
+
+    BERT dominates; LSTM/GNN are included; tree + isolation-forest branches
+    are gather/compare programs with ~0 matmul FLOPs.
+    """
+    h, i_, l_, t = (bert_config.hidden_size, bert_config.intermediate_size,
+                    bert_config.num_layers, sc.text_len)
+    per_tok_layer = 2 * (4 * h * h + 2 * h * i_)      # qkv+o, ffn up+down
+    attn = 2 * 2 * t * t * h                          # scores + weighted sum
+    bert = l_ * (t * per_tok_layer + attn) + t * 2 * h * h  # + pooler-ish head
+    lstm_h = 128
+    lstm = sc.seq_len * 2 * (sc.feature_dim + lstm_h) * 4 * lstm_h
+    gnn = 2 * (2 * sc.fanout * sc.node_dim * 64 + 3 * 64 * 64)  # rough, tiny
+    return float(batch * (bert + lstm + gnn))
+
+
+def run_bench() -> None:
+    import jax
+    import jax.numpy as jnp
+
     from realtime_fraud_detection_tpu.ensemble.combine import (
         EnsembleParams,
         combine_predictions,
@@ -99,10 +238,15 @@ def main() -> None:
     from realtime_fraud_detection_tpu.utils.config import Config
 
     on_tpu = jax.devices()[0].platform != "cpu"
+    device_label = os.environ.get("RTFD_BENCH_DEVICE_LABEL",
+                                  str(jax.devices()[0]))
     # Real DistilBERT-base dimensions for the text branch (config.py:165-170),
-    # trimmed to 2 layers on CPU so local runs stay tractable.
+    # trimmed to 2 layers on CPU so fallback runs stay tractable.
     bert_config = BertConfig() if on_tpu else BertConfig(num_layers=2)
     sc = ScorerConfig(text_len=64)
+    # Iteration scale: full on TPU; reduced on the CPU fallback so a wedged
+    # relay still yields a complete JSON well inside the orchestrator timeout.
+    it = (lambda n: n) if on_tpu else (lambda n: max(5, n // 10))
 
     models = init_scoring_models(
         jax.random.PRNGKey(0), bert_config=bert_config,
@@ -135,7 +279,7 @@ def main() -> None:
         )
         try:
             bert_times[flag] = _time_blocked(
-                lambda: bfn(dev_models.bert, tok, tokm), 30)
+                lambda: bfn(dev_models.bert, tok, tokm), it(30))
         except Exception as e:  # pallas unavailable on this platform
             pallas_report["error"] = f"{type(e).__name__}: {e}"[:200]
     if True in bert_times:
@@ -158,7 +302,7 @@ def main() -> None:
 
     # ------------------------------------------------- latency decomposition
     lat: dict[str, dict] = {}
-    for bsz, iters in ((1, 200), (32, 100), (256, 100)):
+    for bsz, iters in ((1, it(200)), (32, it(100)), (256, it(100))):
         _log(f'latency decomposition b={bsz}')
         host_b, dev_b = batches[bsz], dev_batches[bsz]
         e2e = _time_blocked(
@@ -195,9 +339,9 @@ def main() -> None:
     tfn = jax.jit(lambda t, f: tree_ensemble_predict(t, f))
     configs["xgboost_batch1"] = {
         "latency": _percentiles(_time_blocked(
-            lambda: tfn(dev_models.trees, f1), 200)),
+            lambda: tfn(dev_models.trees, f1), it(200))),
         "txn_per_s": round(_throughput_pipelined(
-            lambda: tfn(dev_models.trees, f1), 1, 200), 1),
+            lambda: tfn(dev_models.trees, f1), 1, it(200)), 1),
     }
     # native C++ tree kernel, the true CPU baseline for config 1
     try:
@@ -206,7 +350,7 @@ def main() -> None:
         scorer_cpu = NativeTreeScorer(jax.device_get(models.trees))
         feats1 = np.asarray(batches[1].features)
         t0 = time.perf_counter()
-        n_iters = 2000
+        n_iters = it(2000)
         for _ in range(n_iters):
             scorer_cpu.predict(feats1)
         cpu_s = (time.perf_counter() - t0) / n_iters
@@ -231,10 +375,10 @@ def main() -> None:
     xifn = jax.jit(_xgb_if)
     configs["xgb_iforest_mb32"] = {
         "latency": _percentiles(_time_blocked(
-            lambda: xifn(dev_models.trees, dev_models.iforest, f32_), 100)),
+            lambda: xifn(dev_models.trees, dev_models.iforest, f32_), it(100))),
         "txn_per_s": round(_throughput_pipelined(
             lambda: xifn(dev_models.trees, dev_models.iforest, f32_),
-            32, 200), 1),
+            32, it(200)), 1),
     }
 
     _log('config 2 (xgb+iforest mb32) done')
@@ -244,9 +388,9 @@ def main() -> None:
     configs["bert_encoder"] = {
         "batch": 256,
         "latency": _percentiles(_time_blocked(
-            lambda: bfn(dev_models.bert, tok, tokm), 50)),
+            lambda: bfn(dev_models.bert, tok, tokm), it(50))),
         "txn_per_s": round(_throughput_pipelined(
-            lambda: bfn(dev_models.bert, tok, tokm), 256, 50), 1),
+            lambda: bfn(dev_models.bert, tok, tokm), 256, it(50)), 1),
         "layers": bert_config.num_layers,
         "hidden": bert_config.hidden_size,
     }
@@ -258,9 +402,9 @@ def main() -> None:
     configs["lstm_seq"] = {
         "batch": 256,
         "latency": _percentiles(_time_blocked(
-            lambda: lfn(dev_models.lstm, hist, hlen), 100)),
+            lambda: lfn(dev_models.lstm, hist, hlen), it(100))),
         "txn_per_s": round(_throughput_pipelined(
-            lambda: lfn(dev_models.lstm, hist, hlen), 256, 100), 1),
+            lambda: lfn(dev_models.lstm, hist, hlen), 256, it(100)), 1),
     }
 
     _log('config 4 (lstm) done')
@@ -270,12 +414,29 @@ def main() -> None:
         "batch": 256,
         "latency": lat["256"]["device"],
         "txn_per_s": round(_throughput_pipelined(
-            lambda: fn(dev_models, db, params, model_valid), 256, 50), 1),
+            lambda: fn(dev_models, db, params, model_valid), 256, it(50)), 1),
     }
 
     throughput = configs["graphsage_full_ensemble"]["txn_per_s"]
 
     _log('config 5 (full ensemble) done')
+    # -------------------------------------------------------------------- MFU
+    # Achieved matmul TFLOP/s of the fused batch=256 program against the
+    # chip's bf16 peak (VERDICT r2 item 8). FLOPs are analytic (counted from
+    # the model dims, 2*M*N*K per matmul); time is the device-resident p50 so
+    # host/tunnel overhead doesn't dilute the number.
+    flops = _ensemble_matmul_flops(bert_config, sc, 256)
+    dev_p50_s = lat["256"]["device"]["p50_ms"] / 1e3
+    achieved_tflops = flops / dev_p50_s / 1e12
+    peak = next((v for k, v in _PEAK_BF16_TFLOPS.items()
+                 if k in str(jax.devices()[0]).lower()), None)
+    mfu = {
+        "matmul_flops_batch256": flops,
+        "achieved_tflops": round(achieved_tflops, 3),
+        "peak_bf16_tflops": peak,
+        "mfu": round(achieved_tflops / peak, 4) if peak else None,
+    }
+
     # ------------------------------------------------------- e2e stream soak
     e2e_stream = {}
     try:
@@ -298,7 +459,7 @@ def main() -> None:
         scorer.seed_profiles(gen.users.profiles(), gen.merchants.profiles())
         job = StreamJob(broker, scorer,
                         JobConfig(max_batch=256, emit_features=False))
-        n_txn = 20_000
+        n_txn = 20_000 if on_tpu else 3_000
         broker.produce_batch(T.TRANSACTIONS, gen.generate_batch(n_txn),
                              key_fn=lambda r: str(r["user_id"]))
         t0 = time.perf_counter()
@@ -313,20 +474,22 @@ def main() -> None:
         e2e_stream = {"error": f"{type(e).__name__}: {e}"[:200]}
 
     _log(f'e2e stream soak done: {e2e_stream}')
-    baseline_tps = 15_000.0  # reference README.md:201 (whole cluster)
     print(json.dumps({
-        "metric": "full-ensemble scoring throughput (5 branches, batch=256, "
-                  "pipelined)",
+        "metric": METRIC_NAME,
         "value": throughput,
         "unit": "txn/s/chip",
-        "vs_baseline": round(throughput / baseline_tps, 3),
+        "vs_baseline": round(throughput / BASELINE_TPS, 3),
         "configs": configs,
         "latency": lat,
         "pallas": pallas_report,
+        "mfu": mfu,
         "e2e_stream": e2e_stream,
-        "device": str(jax.devices()[0]),
-    }))
+        "device": device_label,
+    }), flush=True)
 
 
 if __name__ == "__main__":
-    main()
+    if "--inner" in sys.argv:
+        run_bench()
+    else:
+        orchestrate()
