@@ -79,7 +79,11 @@ class PendingScore:
     n: int
     out: Any
     features: np.ndarray
-    t0: float
+    # Host-side assemble+dispatch cost, captured when dispatch() returns.
+    # Under two-deep pipelining the wall time between dispatch and finalize
+    # includes queue wait (the caller is off assembling the next batch), so
+    # finalize() measures its own device wait and adds this — never the gap.
+    dispatch_ms: float
 
 
 class _EntityIndex:
@@ -298,7 +302,8 @@ class FraudScorer:
         n = len(records)
         if n == 0:
             return PendingScore(records=[], n=0, out=None,
-                                features=self.last_features[:0], t0=t0)
+                                features=self.last_features[:0],
+                                dispatch_ms=0.0)
         batch = self.assemble(records, now)
         padded, mask, _ = pad_to_bucket(
             batch, n, BATCH_BUCKETS, multiple_of=local_mesh_size(self.mesh)
@@ -313,7 +318,8 @@ class FraudScorer:
             bert_config=self.bert_config, use_pallas=self.sc.use_pallas,
         )
         return PendingScore(records=list(records), n=n, out=out,
-                            features=self.last_features, t0=t0)
+                            features=self.last_features,
+                            dispatch_ms=(time.perf_counter() - t0) * 1000.0)
 
     def finalize(self, pending: "PendingScore", now: Optional[float] = None,
                  lock=None) -> List[Dict[str, Any]]:
@@ -327,8 +333,12 @@ class FraudScorer:
 
         if pending.n == 0:
             return []
+        t_fin = time.perf_counter()
         out = jax.device_get(pending.out)      # blocks until device is done
-        elapsed_ms = (time.perf_counter() - pending.t0) * 1000.0
+        # processing time = assemble/dispatch + device wait; excludes any
+        # pipeline queue wait between dispatch() returning and this call
+        elapsed_ms = (pending.dispatch_ms
+                      + (time.perf_counter() - t_fin) * 1000.0)
         results = self._build_responses(pending.records, out, pending.n,
                                         elapsed_ms)
         with (lock if lock is not None else contextlib.nullcontext()):

@@ -180,6 +180,22 @@ class StreamJob:
                 for r in fresh
             ]
 
+        try:
+            return self._fan_out(ctx, fresh, results, feats, scored_ok, now)
+        finally:
+            # ALWAYS release, even when fan-out raises mid-way (broker down):
+            # a leaked id makes the replayed record look like an in-flight
+            # duplicate, so it would be skipped and the next commit would
+            # advance past it — silent record loss (ADVICE r2). With the ids
+            # released, an uncommitted batch replays and rescans normally
+            # (txn-cache dedupe still guards the already-written-back case).
+            self._inflight_ids -= ctx.ids
+
+    def _fan_out(self, ctx: "_BatchCtx", fresh: List[Record],
+                 results: List[Dict[str, Any]], feats, scored_ok: bool,
+                 now: Optional[float]) -> List[Dict[str, Any]]:
+        """Enrich + produce to output topics + commit (stage-2 tail)."""
+        cfg = self.config
         enriched_scores = None
         wants_enriched = cfg.emit_enriched or self.analytics is not None
         if cfg.enable_enrichment and scored_ok and wants_enriched:
@@ -244,7 +260,6 @@ class StreamJob:
                 )
         self.counters["scored"] += len(fresh)
         self.counters["batches"] += 1
-        self._inflight_ids -= ctx.ids
         # commit AFTER fan-out + scorer write-back: at-least-once
         self.consumer.commit(ctx.positions)
         return results

@@ -26,6 +26,7 @@ from __future__ import annotations
 import dataclasses
 import threading
 import time
+import zlib
 from typing import Any, Callable, Dict, Iterable, List, Mapping, Optional, Sequence
 
 import numpy as np
@@ -115,10 +116,15 @@ class InMemoryBroker:
     # -------------------------------------------------------------- produce
     def select_partition(self, topic: str, key: Optional[str]) -> int:
         """Key hash (same key -> same partition -> per-key ordering), or
-        round-robin for unkeyed records, like Kafka's default partitioner."""
+        round-robin for unkeyed records, like Kafka's default partitioner.
+
+        crc32, NOT ``hash()``: Python salts ``str.__hash__`` per process, so
+        a WAL-backed broker restarted with ``hash()`` would route old keys to
+        new partitions and break per-key ordering. Matches stream/kafka.py's
+        partitioner so the two transports agree on key->partition."""
         logs = self._logs(topic)
         if key is not None:
-            return hash(key) % len(logs)
+            return zlib.crc32(key.encode()) % len(logs)
         with self._lock:
             part = self._rr.get(topic, 0) % len(logs)
             self._rr[topic] = part + 1

@@ -92,6 +92,26 @@ def test_fraud_scorer_state_accumulates():
     assert scorer.stats["scored"] == 10
 
 
+def test_processing_time_excludes_pipeline_queue_wait():
+    """Under pipelining, the gap between dispatch() returning and finalize()
+    being called is queue wait, not processing — reported processing_time_ms
+    must not include it (ADVICE r2, scorer.py elapsed_ms)."""
+    import time
+
+    gen = TransactionGenerator(num_users=10, num_merchants=5, seed=4)
+    scorer = FraudScorer(scorer_config=ScorerConfig(text_len=32))
+    scorer.seed_profiles(gen.users.profiles(), gen.merchants.profiles())
+    recs = gen.generate_batch(4)
+    # warm up compile so the timed run measures steady state
+    scorer.score_batch(recs[:1], now=999.0)
+
+    pending = scorer.dispatch(recs, now=1000.0)
+    jax.block_until_ready(pending.out)   # device done BEFORE the queue wait
+    time.sleep(0.3)                      # simulated pipeline queue wait
+    results = scorer.finalize(pending, now=1000.0)
+    assert results[0]["processing_time_ms"] * len(recs) < 250.0
+
+
 def test_fraud_scorer_padding_invariance():
     """Bucket padding must not change real-row scores."""
     gen = TransactionGenerator(num_users=20, num_merchants=10, seed=3)
