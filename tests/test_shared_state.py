@@ -181,3 +181,159 @@ def test_scorer_runs_on_shared_stores(server):
     assert s2.txn_cache.get_transaction(tid) is not None
     c1.close()
     c2.close()
+
+
+# ---------------------------------------- eviction / persistence / replication
+
+
+def test_lru_eviction_under_memory_cap():
+    """maxmemory + allkeys-lru (reference redis-master.conf:17-18): a write
+    burst beyond the cap evicts the least-recently-used keys, stays under
+    the cap, and keeps the hot (recently touched) keys."""
+    s = MiniRedisServer(maxmemory=20_000).start()
+    c = RespClient(port=s.port)
+    try:
+        for i in range(200):
+            c.set(f"k{i}", "x" * 80)
+            c.get("k0")          # keep k0 hot the whole time
+        assert s.used_memory <= 20_000
+        assert s.evicted_keys > 0
+        assert c.dbsize() < 200
+        assert c.get("k0") == b"x" * 80          # hot key survived
+        assert c.get("k199") == b"x" * 80        # newest key survived
+        assert c.get("k1") is None               # cold early key evicted
+        info = c.info()
+        assert int(info["evicted_keys"]) == s.evicted_keys
+        assert info["maxmemory_policy"] == "allkeys-lru"
+    finally:
+        c.close()
+        s.stop()
+
+
+def test_noeviction_policy_returns_oom():
+    from realtime_fraud_detection_tpu.state.resp import RespError
+
+    s = MiniRedisServer(maxmemory=2_000, policy="noeviction").start()
+    c = RespClient(port=s.port)
+    try:
+        with pytest.raises(RespError, match="OOM"):
+            for i in range(100):
+                c.set(f"k{i}", "x" * 100)
+        c.delete("k0")            # DEL is allowed over the cap
+    finally:
+        c.close()
+        s.stop()
+
+
+def test_aof_kill_and_restart_preserves_state(tmp_path):
+    """Kill the state server, start a new one on the same AOF: profiles,
+    velocity hashes, lists, counters and live TTLs all survive; expired
+    TTLs stay dead (absolute PEXPIREAT rewriting)."""
+    aof = str(tmp_path / "state.aof")
+    s1 = MiniRedisServer(aof_path=aof).start()
+    c1 = RespClient(port=s1.port)
+    c1.set("profile:user:42", '{"avg":12.5}')
+    c1.hset("velocity:u42:5min", "count", 3, "amount", 99.5)
+    c1.hincrby("velocity:u42:5min", "count", 2)
+    c1.lpush("txns:u42", "t1", "t2", "t3")
+    c1.incr("counter")
+    c1.set("live-ttl", "here", ex=3600)
+    c1.set("dead-ttl", "gone", ex=0.05)
+    c1.setnx("nx-miss", "a")
+    c1.setnx("nx-miss", "b")     # no-op: must not corrupt replay
+    import time as _t
+    _t.sleep(0.1)
+    c1.close()
+    s1.stop()                    # hard stop: nothing flushed beyond the log
+
+    s2 = MiniRedisServer(aof_path=aof).start()
+    c2 = RespClient(port=s2.port)
+    try:
+        assert c2.get("profile:user:42") == b'{"avg":12.5}'
+        h = c2.hgetall("velocity:u42:5min")
+        assert h["count"] == b"5" and h["amount"] == b"99.5"
+        assert c2.lrange("txns:u42", 0, -1) == [b"t3", b"t2", b"t1"]
+        assert c2.get("counter") == b"1"
+        assert c2.get("live-ttl") == b"here"
+        assert c2.execute("TTL", "live-ttl") > 3000  # absolute, not re-armed
+        assert c2.get("dead-ttl") is None
+        assert c2.get("nx-miss") == b"a"
+    finally:
+        c2.close()
+        s2.stop()
+
+
+def test_aof_rewrite_compacts_and_replays(tmp_path):
+    import os
+
+    aof = str(tmp_path / "state.aof")
+    s1 = MiniRedisServer(aof_path=aof).start()
+    c1 = RespClient(port=s1.port)
+    for i in range(50):
+        c1.set("churn", f"v{i}")          # 50 log entries, 1 live key
+    size_before = os.path.getsize(aof)
+    s1.rewrite_aof()
+    assert os.path.getsize(aof) < size_before
+    c1.set("after-rewrite", "1")          # appends still work post-rewrite
+    c1.close()
+    s1.stop()
+
+    s2 = MiniRedisServer(aof_path=aof).start()
+    c2 = RespClient(port=s2.port)
+    try:
+        assert c2.get("churn") == b"v49"
+        assert c2.get("after-rewrite") == b"1"
+    finally:
+        c2.close()
+        s2.stop()
+
+
+def _wait_for(pred, timeout_s=5.0):
+    import time as _t
+
+    deadline = _t.monotonic() + timeout_s
+    while _t.monotonic() < deadline:
+        if pred():
+            return True
+        _t.sleep(0.02)
+    return False
+
+
+def test_replication_snapshot_stream_and_failover():
+    """Replica SYNCs existing state, converges on new writes, rejects
+    client writes, and after promote() accepts them (the reference's
+    3-master+3-replica failover story, docker-compose.yml redis services)."""
+    from realtime_fraud_detection_tpu.state.resp import RespError
+
+    primary = MiniRedisServer().start()
+    cp = RespClient(port=primary.port)
+    cp.set("pre-sync", "snapshot-me")
+    cp.hset("h", "f", "1")
+
+    replica = MiniRedisServer(replica_of=("127.0.0.1", primary.port)).start()
+    cr = RespClient(port=replica.port)
+    try:
+        # snapshot
+        assert _wait_for(lambda: cr.get("pre-sync") == b"snapshot-me")
+        # live stream
+        cp.set("post-sync", "stream-me")
+        cp.hincrby("h", "f", 4)
+        cp.set("ttl-key", "x", ex=3600)
+        assert _wait_for(lambda: cr.get("post-sync") == b"stream-me")
+        assert _wait_for(lambda: cr.hget("h", "f") == b"5")
+        assert cr.execute("TTL", "ttl-key") > 3000
+        assert cr.info()["role"] == "slave"
+        # read-only
+        with pytest.raises(RespError, match="READONLY"):
+            cr.set("nope", "1")
+        # failover: primary dies, replica promoted, writes flow again
+        cp.close()
+        primary.stop()
+        replica.promote()
+        assert _wait_for(lambda: cr.info()["role"] == "master")
+        cr.set("after-failover", "1")
+        assert cr.get("after-failover") == b"1"
+        assert cr.get("pre-sync") == b"snapshot-me"  # nothing lost
+    finally:
+        cr.close()
+        replica.stop()
