@@ -6,8 +6,11 @@ FraudDetectionJob.java:141-213, scripts/setup/create-topics.sh). No Kafka
 client library is baked into this image, so this module implements the
 protocol directly over TCP (the format is public: kafka.apache.org/protocol):
 
-  Metadata v1 · Produce v2 (MessageSet v1 + CRC32) · Fetch v2 ·
-  ListOffsets v1 · FindCoordinator v0 · OffsetCommit v2 · OffsetFetch v1
+  Metadata v1 · Produce v2 (MessageSet v1 + CRC32) · Produce v3
+  (RecordBatch v2 + CRC32C, idempotent) · Fetch v2 · ListOffsets v1 ·
+  FindCoordinator v0 · OffsetCommit v2 · OffsetFetch v1 ·
+  InitProducerId v0 · JoinGroup v1 · SyncGroup v0 · Heartbeat v0 ·
+  LeaveGroup v0 (membership client lives in stream/kafka_group.py)
 
 ``KafkaBroker`` exposes the exact broker interface the framework's
 ``transport.Consumer`` consumes (committed/partitions/read/commit/lag plus
@@ -16,11 +19,17 @@ unchanged against a real cluster — same contract suite as InMemoryBroker
 and NetBrokerClient (tests/test_kafka.py runs it against an in-process
 protocol fake, stream/kafka_fake.py).
 
+Production semantics (reference config/kafka/*.properties):
+- ``idempotent=True`` == ``enable.idempotence=true`` (producer.properties:8):
+  batches go out as RecordBatch v2 stamped (producer_id, epoch,
+  base_sequence) via InitProducerId + Produce v3; a retry after a lost ack
+  resends the SAME sequence and the broker dedupes it. acks defaults to -1
+  (``acks=all``, producer.properties:19).
+- ``consumer(..., group_managed=True)`` == the reference's consumer group
+  (consumer.properties:5): coordinator-managed membership with automatic
+  partition rebalance on member death (stream/kafka_group.py).
+
 Scope notes (deliberate, documented):
-- Offset commits use the group coordinator in *simple consumer* mode
-  (generation_id=-1, member_id=""): static partition assignment per
-  process, like the reference Flink job's fixed parallelism — the group
-  REBALANCE protocol (JoinGroup/SyncGroup/Heartbeat) is not implemented.
 - Messages are uncompressed (attributes=0): no lz4 codec exists in this
   image's stdlib. The app-layer payloads are small JSON dicts; compression
   is a deployment knob, not a semantic.
@@ -53,11 +62,24 @@ API_METADATA = 3
 API_OFFSET_COMMIT = 8
 API_OFFSET_FETCH = 9
 API_FIND_COORDINATOR = 10
+API_JOIN_GROUP = 11
+API_HEARTBEAT = 12
+API_LEAVE_GROUP = 13
+API_SYNC_GROUP = 14
+API_INIT_PRODUCER_ID = 22
+
+ERR_OFFSET_OUT_OF_RANGE = 1
+ERR_ILLEGAL_GENERATION = 22
+ERR_UNKNOWN_MEMBER_ID = 25
+ERR_REBALANCE_IN_PROGRESS = 27
+ERR_OUT_OF_ORDER_SEQUENCE = 45
 
 _ERRORS = {
     0: "NONE", 1: "OFFSET_OUT_OF_RANGE", 3: "UNKNOWN_TOPIC_OR_PARTITION",
     5: "LEADER_NOT_AVAILABLE", 6: "NOT_LEADER_FOR_PARTITION",
     15: "COORDINATOR_NOT_AVAILABLE", 16: "NOT_COORDINATOR",
+    22: "ILLEGAL_GENERATION", 25: "UNKNOWN_MEMBER_ID",
+    27: "REBALANCE_IN_PROGRESS", 45: "OUT_OF_ORDER_SEQUENCE_NUMBER",
 }
 
 
@@ -214,6 +236,131 @@ def decode_message_set(buf: bytes) -> List[Tuple[int, Optional[bytes], Optional[
 
 
 # ---------------------------------------------------------------------------
+# RecordBatch v2 (magic=2): the format idempotent producers must use — it is
+# the only record format carrying producerId/producerEpoch/baseSequence
+# (reference producer.properties:8 enable.idempotence=true). Varint-encoded
+# records, CRC32C (Castagnoli) integrity — implemented here because zlib
+# only has CRC32.
+# ---------------------------------------------------------------------------
+
+
+def _crc32c_table() -> List[int]:
+    table = []
+    for i in range(256):
+        crc = i
+        for _ in range(8):
+            crc = (crc >> 1) ^ 0x82F63B78 if crc & 1 else crc >> 1
+        table.append(crc)
+    return table
+
+
+_CRC32C = _crc32c_table()
+
+
+def crc32c(data: bytes) -> int:
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = _CRC32C[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _write_varint(out: bytearray, v: int) -> None:
+    """Zigzag + LEB128, the Kafka record field encoding."""
+    u = ((v << 1) ^ (v >> 63)) & ((1 << 64) - 1)
+    while True:
+        if u < 0x80:
+            out.append(u)
+            return
+        out.append((u & 0x7F) | 0x80)
+        u >>= 7
+
+
+def _read_varint(buf: bytes, pos: int) -> Tuple[int, int]:
+    shift, u = 0, 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        u |= (b & 0x7F) << shift
+        if not b & 0x80:
+            break
+        shift += 7
+    v = (u >> 1) ^ -(u & 1)
+    return v, pos
+
+
+def encode_record_batch(
+    messages: Sequence[Tuple[Optional[bytes], Optional[bytes], int]],
+    producer_id: int = -1, producer_epoch: int = -1,
+    base_sequence: int = -1,
+) -> bytes:
+    """[(key, value, timestamp_ms)] -> RecordBatch v2 bytes."""
+    first_ts = messages[0][2]
+    max_ts = max(m[2] for m in messages)
+    records = bytearray()
+    for i, (key, value, ts) in enumerate(messages):
+        body = bytearray()
+        body.append(0)                            # record attributes
+        _write_varint(body, ts - first_ts)
+        _write_varint(body, i)                    # offset delta
+        for blob in (key, value):
+            if blob is None:
+                _write_varint(body, -1)
+            else:
+                _write_varint(body, len(blob))
+                body.extend(blob)
+        _write_varint(body, 0)                    # headers
+        _write_varint(records, len(body))
+        records.extend(body)
+    after_crc = (
+        struct.pack(">hiqqqhii", 0, len(messages) - 1, first_ts, max_ts,
+                    producer_id, producer_epoch, base_sequence,
+                    len(messages))
+        + bytes(records)
+    )
+    crc = crc32c(after_crc)
+    tail = struct.pack(">ibI", -1, 2, crc) + after_crc   # leaderEpoch, magic
+    return struct.pack(">qi", 0, len(tail)) + tail       # baseOffset, length
+
+
+def decode_record_batch(buf: bytes) -> Tuple[
+    List[Tuple[int, Optional[bytes], Optional[bytes], int]], int, int, int,
+]:
+    """RecordBatch v2 bytes -> ([(offset_delta, key, value, ts_ms)],
+    producer_id, producer_epoch, base_sequence). Verifies CRC32C."""
+    base_offset, _length, _epoch, magic, crc = struct.unpack_from(">qiibI", buf)
+    if magic != 2:
+        raise ValueError(f"not a v2 record batch (magic={magic})")
+    after_crc = buf[21:]
+    if crc32c(after_crc) != crc:
+        raise ValueError("bad CRC32C in record batch")
+    (_attrs, _last_delta, first_ts, _max_ts, pid, pepoch, base_seq,
+     count) = struct.unpack_from(">hiqqqhii", after_crc)
+    pos = struct.calcsize(">hiqqqhii")
+    out: List[Tuple[int, Optional[bytes], Optional[bytes], int]] = []
+    for _ in range(count):
+        _rec_len, pos = _read_varint(after_crc, pos)
+        pos += 1                                  # record attributes
+        ts_delta, pos = _read_varint(after_crc, pos)
+        off_delta, pos = _read_varint(after_crc, pos)
+        blobs: List[Optional[bytes]] = []
+        for _f in range(2):
+            n, pos = _read_varint(after_crc, pos)
+            if n < 0:
+                blobs.append(None)
+            else:
+                blobs.append(after_crc[pos:pos + n])
+                pos += n
+        n_headers, pos = _read_varint(after_crc, pos)
+        for _h in range(n_headers):
+            for _kv in range(2):
+                n, pos = _read_varint(after_crc, pos)
+                pos += max(0, n)
+        out.append((base_offset + off_delta, blobs[0], blobs[1],
+                    first_ts + ts_delta))
+    return out, pid, pepoch, base_seq
+
+
+# ---------------------------------------------------------------------------
 # connection: framed request/response with correlation ids
 # ---------------------------------------------------------------------------
 
@@ -225,10 +372,23 @@ class KafkaConnection:
                  timeout_s: float = 30.0):
         self.host, self.port = host, port
         self.client_id = client_id
+        self.timeout_s = timeout_s
         self._sock = socket.create_connection((host, port), timeout=timeout_s)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._lock = threading.Lock()
         self._corr = 0
+
+    def reconnect(self) -> None:
+        """Re-dial after a broken connection (the idempotent producer's
+        retry path: resend the SAME batch/sequence on the new socket)."""
+        with self._lock:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = socket.create_connection(
+                (self.host, self.port), timeout=self.timeout_s)
+            self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
 
     def close(self) -> None:
         try:
@@ -288,7 +448,7 @@ class KafkaBroker:
 
     def __init__(self, bootstrap: str = "127.0.0.1:9092",
                  client_id: str = "rtfd-tpu", acks: int = -1,
-                 timeout_s: float = 30.0):
+                 timeout_s: float = 30.0, idempotent: bool = False):
         host, _, port = bootstrap.partition(":")
         self.acks = acks                         # -1 == acks=all (reference)
         self.timeout_s = timeout_s
@@ -297,6 +457,22 @@ class KafkaBroker:
         self._coord: Optional[KafkaConnection] = None
         self._meta: Dict[str, List[int]] = {}    # topic -> partition ids
         self._rr: Dict[str, int] = {}
+        # idempotent produce (producer.properties:8 enable.idempotence=true):
+        # RecordBatch v2 stamped with (producer_id, epoch, base_sequence);
+        # the broker dedupes a retried batch by sequence number, so a resend
+        # after a lost ack cannot double-append.
+        self.idempotent = idempotent
+        if idempotent and acks == 0:
+            raise ValueError("idempotent produce requires acks != 0")
+        self._pid = -1
+        self._pepoch = -1
+        self._seq: Dict[Tuple[str, int], int] = {}   # (topic, part) -> next
+        # _seq_lock guards only pid init + per-partition lock creation; the
+        # network I/O (and its retries/backoff) runs under a PER-PARTITION
+        # lock, so a wedged partition can't serialize the whole producer —
+        # while same-partition produces stay strictly in sequence order.
+        self._seq_lock = threading.Lock()
+        self._part_locks: Dict[Tuple[str, int], threading.Lock] = {}
 
     def close(self) -> None:
         self._conn.close()
@@ -375,17 +551,84 @@ class KafkaBroker:
             self._produce_raw(topic, part, msgs)
         return n
 
+    def _init_producer_id(self) -> None:
+        """InitProducerId v0: acquire (producer_id, epoch) for idempotence."""
+        body = Writer().string(None).i32(60_000).done()
+        r = self._conn.request(API_INIT_PRODUCER_ID, 0, body)
+        r.i32()                                   # throttle_time_ms
+        err = r.i16()
+        if err:
+            raise KafkaProtocolError("InitProducerId", err)
+        self._pid = r.i64()
+        self._pepoch = r.i16()
+
     def _produce_raw(self, topic: str, partition: int,
                      messages: List[Tuple[Optional[bytes], Optional[bytes], int]]) -> int:
-        record_set = encode_message_set(messages)
+        if not self.idempotent:
+            return self._produce_request(
+                topic, partition, encode_message_set(messages), api_version=2)
+        key = (topic, partition)
+        with self._seq_lock:
+            if self._pid < 0:
+                self._init_producer_id()
+            pid, pepoch = self._pid, self._pepoch
+            plock = self._part_locks.setdefault(key, threading.Lock())
+        with plock:
+            with self._seq_lock:
+                if self._pid != pid:       # identity reset by another thread
+                    pid, pepoch = self._pid, self._pepoch
+                    if pid < 0:
+                        self._init_producer_id()
+                        pid, pepoch = self._pid, self._pepoch
+                seq = self._seq.get(key, 0)
+            record_set = encode_record_batch(
+                messages, producer_id=pid, producer_epoch=pepoch,
+                base_sequence=seq)
+            # Retry the SAME bytes (same baseSequence) across connection
+            # failures: the broker recognizes a replayed sequence and
+            # returns the original offset instead of double-appending —
+            # this is what enable.idempotence=true means.
+            last_exc: Optional[Exception] = None
+            for attempt in range(3):
+                try:
+                    off = self._produce_request(
+                        topic, partition, record_set, api_version=3)
+                    with self._seq_lock:
+                        self._seq[key] = seq + len(messages)
+                    return off
+                except (ConnectionError, OSError) as e:
+                    last_exc = e
+                    time.sleep(0.05 * (attempt + 1))
+                    try:
+                        self._conn.reconnect()
+                    except OSError:
+                        continue
+            # Retries exhausted with the batch's fate unknown: the broker
+            # may have appended it. The sequence is now unresolvable — a
+            # LATER batch reusing it would be silently deduped as a
+            # "retry" and lost. Discard the producer identity; the next
+            # produce re-runs InitProducerId for a fresh (pid, seq=0).
+            with self._seq_lock:
+                self._pid = -1
+                self._pepoch = -1
+                self._seq.clear()
+            raise ConnectionError(
+                f"produce to {topic}/{partition} failed after retries"
+            ) from last_exc
+
+    def _produce_request(self, topic: str, partition: int,
+                         record_set: bytes, api_version: int) -> int:
+        w = Writer()
+        if api_version >= 3:
+            w.string(None)                        # transactional_id
         body = (
-            Writer().i16(self.acks).i32(int(self.timeout_s * 1000))
-            .array([None], lambda w, _:
-                   w.string(topic).array([None], lambda w2, _2:
-                                         w2.i32(partition).bytes_(record_set)))
+            w.i16(self.acks).i32(int(self.timeout_s * 1000))
+            .array([None], lambda ww, _:
+                   ww.string(topic).array([None], lambda w2, _2:
+                                          w2.i32(partition).bytes_(record_set)))
             .done()
         )
-        r = self._conn.request(API_PRODUCE, 2, body,
+        r = self._conn.request(API_PRODUCE, api_version, body,
                                expect_response=self.acks != 0)
         if r is None:                             # acks=0: fire and forget
             return -1
@@ -492,14 +735,19 @@ class KafkaBroker:
             self._invalidate_coordinator()
             return do(self._coordinator(group))
 
-    def commit(self, group: str, offsets: Mapping[tuple, int]) -> None:
+    def commit(self, group: str, offsets: Mapping[tuple, int],
+               generation_id: int = -1, member_id: str = "") -> None:
+        """Commit offsets. ``generation_id``/``member_id`` default to simple
+        consumer mode; a GroupConsumer passes its membership so the
+        coordinator fences commits from a member evicted by a rebalance."""
         by_topic: Dict[str, List[Tuple[int, int]]] = {}
         for (topic, part), off in offsets.items():
             by_topic.setdefault(topic, []).append((part, off))
         if not by_topic:
             return
         body = (
-            Writer().string(group).i32(-1).string("").i64(-1)
+            Writer().string(group).i32(generation_id).string(member_id)
+            .i64(-1)
             .array(sorted(by_topic.items()), lambda w, kv:
                    w.string(kv[0]).array(kv[1], lambda w2, po:
                                          w2.i32(po[0]).i64(po[1])
@@ -551,7 +799,23 @@ class KafkaBroker:
 
     # ------------------------------------------------------------- consume
     def consumer(self, topics: Sequence[str], group_id: str,
-                 faults: Optional[FaultInjector] = None) -> Consumer:
+                 faults: Optional[FaultInjector] = None,
+                 group_managed: bool = False):
+        """Static-assignment consumer by default; ``group_managed=True``
+        returns a coordinator-managed member (JoinGroup/SyncGroup/Heartbeat,
+        stream/kafka_group.py) so N StreamJob processes in one group split
+        partitions and fail over automatically, like the reference's
+        consumer group (consumer.properties:5)."""
+        if group_managed:
+            if faults is not None:
+                raise ValueError(
+                    "fault injection is not supported on group-managed "
+                    "consumers; use the static consumer for chaos tests")
+            from realtime_fraud_detection_tpu.stream.kafka_group import (
+                KafkaGroupConsumer,
+            )
+
+            return KafkaGroupConsumer(self, list(topics), group_id)
         return Consumer(self, list(topics), group_id, faults)
 
     def create_topic(self, name: str, partitions: int) -> None:
