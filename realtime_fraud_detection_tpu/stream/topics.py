@@ -1,10 +1,11 @@
 """Topic contract: names, partition counts, retention/compaction classes.
 
-Mirror of the reference's Kafka topic contract (create-topics.sh:101-160):
-29 topics across core / behavioral / alert / stream-processing / analytics /
-test groups, RF=3 minISR=2 lz4 in the real deployment. The in-memory broker
-honors the same names and partition counts so partition-keyed ordering
-semantics match a real Kafka deployment.
+Mirror of the reference's Kafka topic contract (create-topics.sh:60-151):
+29 topics — 27 regular + 2 compacted profile topics — across core /
+behavioral / alert / stream-processing / analytics / test groups, RF=3
+minISR=2 lz4 in the real deployment. The in-memory broker honors the same
+names and partition counts so partition-keyed ordering semantics match a
+real Kafka deployment.
 """
 
 from __future__ import annotations
@@ -19,36 +20,43 @@ class TopicSpec:
     compacted: bool = False
 
 
-# (create-topics.sh:101-160)
+# names + partition counts exactly as create-topics.sh materializes them
 TOPIC_SPECS: tuple[TopicSpec, ...] = (
-    # core transaction flow
+    # core transaction flow (create-topics.sh:92-96)
     TopicSpec("payment-transactions", 12),
     TopicSpec("transaction-enriched", 12),
     TopicSpec("transaction-features", 12),
     TopicSpec("fraud-predictions", 12),
     TopicSpec("fraud-decisions", 6),
-    # compacted profile topics
+    # compacted profile topics (:103, :114)
     TopicSpec("user-profiles", 6, compacted=True),
     TopicSpec("merchant-profiles", 4, compacted=True),
-    # behavioral
+    # user & behavioral (:101-110)
     TopicSpec("user-behavior", 8),
-    TopicSpec("session-events", 8),
     TopicSpec("device-fingerprints", 4),
-    # alerts
+    TopicSpec("user-sessions", 6),
+    TopicSpec("login-events", 4),
+    # merchant & risk (:112-120)
+    TopicSpec("merchant-transactions", 8),
+    TopicSpec("risk-signals", 6),
+    TopicSpec("blacklist-updates", 2),
+    # alerts & audit (:122-128)
     TopicSpec("fraud-alerts", 6),
-    TopicSpec("high-risk-transactions", 6),
-    TopicSpec("manual-review-queue", 4),
-    # stream processing
+    TopicSpec("system-alerts", 2),
+    TopicSpec("audit-logs", 4),
+    TopicSpec("model-metrics", 2),
+    # stream processing (:130-136)
     TopicSpec("velocity-checks", 8),
-    TopicSpec("pattern-analysis", 8),
-    TopicSpec("geolocation-events", 6),
-    TopicSpec("merchant-analytics", 4),
-    # analytics / audit
-    TopicSpec("transaction-analytics", 6),
-    TopicSpec("model-metrics", 4),
-    TopicSpec("audit-log", 4),
-    # test topics (create-topics.sh:148-151)
-    TopicSpec("test-transactions", 2),
+    TopicSpec("geographic-analysis", 4),
+    TopicSpec("pattern-detection", 6),
+    TopicSpec("network-analysis", 4),
+    # analytics & reporting (:138-144)
+    TopicSpec("transaction-metrics", 4),
+    TopicSpec("fraud-metrics", 2),
+    TopicSpec("dashboard-updates", 2),
+    TopicSpec("reporting-data", 4),
+    # test topics (:146-151)
+    TopicSpec("test-transactions", 4),
     TopicSpec("model-experiments", 2),
     TopicSpec("feature-experiments", 2),
 )

@@ -640,12 +640,12 @@ def amount_cluster_windows() -> WindowOperator:
 # result topic per operator (create-topics.sh stream-processing group)
 ANALYTICS_TOPIC = {
     "user_velocity": "velocity-checks",
-    "merchant_patterns": "merchant-analytics",
-    "user_sessions": "session-events",
-    "geo_clusters": "geolocation-events",
-    "fraud_patterns": "pattern-analysis",
-    "high_frequency": "high-risk-transactions",
-    "amount_clusters": "transaction-analytics",
+    "merchant_patterns": "merchant-transactions",
+    "user_sessions": "user-sessions",
+    "geo_clusters": "geographic-analysis",
+    "fraud_patterns": "pattern-detection",
+    "high_frequency": "velocity-checks",
+    "amount_clusters": "transaction-metrics",
 }
 
 

@@ -262,3 +262,23 @@ def test_pipelined_commit_covers_only_dispatched_offsets():
     # only batch1's records are covered by the commit: batch2 replays
     lag = broker.lag(job.config.group_id, T.TRANSACTIONS)
     assert lag == len(batch2)
+
+
+def test_topic_contract_mirrors_reference():
+    """29 topics (27 regular + 2 compacted), exact reference names and
+    partition counts (create-topics.sh:60-151)."""
+    from realtime_fraud_detection_tpu.stream.topics import TOPIC_SPECS
+
+    assert len(TOPIC_SPECS) == 29
+    by_name = {t.name: t for t in TOPIC_SPECS}
+    assert by_name["payment-transactions"].partitions == 12
+    assert by_name["user-profiles"].compacted
+    assert by_name["merchant-profiles"].compacted
+    assert sum(t.compacted for t in TOPIC_SPECS) == 2
+    for expected in ("pattern-detection", "geographic-analysis",
+                     "audit-logs", "user-sessions", "login-events",
+                     "blacklist-updates", "system-alerts", "risk-signals",
+                     "network-analysis", "dashboard-updates",
+                     "reporting-data", "merchant-transactions",
+                     "fraud-metrics", "transaction-metrics"):
+        assert expected in by_name, expected
