@@ -1,0 +1,6 @@
+#!/bin/bash
+# Materialize the 29-topic contract on the broker (reference
+# scripts/setup/create-topics.sh analog — topic names/partitions live in
+# code, stream/topics.py, instead of a 189-line shell table).
+set -euo pipefail
+exec python -m realtime_fraud_detection_tpu topics --broker "${1:-127.0.0.1:9092}" --create

@@ -1,0 +1,31 @@
+#!/bin/bash
+# Verify every tier answers on its own protocol (reference health-check.sh
+# analog: HTTP checks + port checks + per-service probes).
+set -uo pipefail
+HOST="${RTFD_HOST:-127.0.0.1}"
+fails=0
+check() {  # name, python-expr (truthy = healthy)
+  printf "%-28s" "$1"
+  if python -c "$2" >/dev/null 2>&1; then echo "OK"; else echo "FAIL"; fails=$((fails+1)); fi
+}
+check "broker (wire protocol)" "
+from realtime_fraud_detection_tpu.stream import NetBrokerClient
+NetBrokerClient(host='$HOST', port=9092).ping()"
+check "state (Redis protocol)" "
+from realtime_fraud_detection_tpu.state import RespClient
+assert RespClient(host='$HOST', port=6379).ping()"
+check "state role/memory" "
+from realtime_fraud_detection_tpu.state import RespClient
+i = RespClient(host='$HOST', port=6379).info(); assert i['role']"
+check "scoring API /health" "
+import urllib.request
+assert urllib.request.urlopen('http://$HOST:8080/health', timeout=5).status == 200"
+check "scoring API /metrics" "
+import urllib.request
+assert b'rtfd' in urllib.request.urlopen('http://$HOST:8080/metrics/prometheus', timeout=5).read()"
+check "topic contract" "
+from realtime_fraud_detection_tpu.stream import NetBrokerClient
+from realtime_fraud_detection_tpu.stream.topics import TOPIC_SPECS
+c = NetBrokerClient(host='$HOST', port=9092)
+assert all(c.partitions(t.name) >= 1 for t in TOPIC_SPECS[:3])"
+exit $fails

@@ -1,0 +1,46 @@
+#!/bin/bash
+# Start the full local stack (reference scripts/setup/start-all.sh analog).
+#
+# The reference sequences 18 containers with fixed sleeps (ZK -> Kafka ->
+# Redis -> Postgres -> Flink -> registry -> monitoring); this framework's
+# topology is 7 services and ordering is expressed as compose healthcheck
+# dependencies, so "start all" is one command — readiness is polled, not
+# slept. Modes:
+#   ./start-all.sh            # docker compose stack (broker/state/job/...)
+#   ./start-all.sh --local    # no docker: processes on localhost
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+if [[ "${1:-}" == "--local" ]]; then
+    echo ">> starting local process stack (no docker)"
+    mkdir -p /tmp/rtfd/{broker,checkpoints}
+    python -m realtime_fraud_detection_tpu broker \
+        --host 127.0.0.1 --port 9092 --log-dir /tmp/rtfd/broker &
+    echo "broker      pid $! :9092"
+    python -m realtime_fraud_detection_tpu state-server \
+        --host 127.0.0.1 --port 6379 --maxmemory $((1 << 30)) \
+        --aof /tmp/rtfd/state.aof &
+    echo "state       pid $! :6379 (1GiB LRU cap + AOF, redis-master.conf analog)"
+    sleep 1
+    python -m realtime_fraud_detection_tpu run-job --count 0 \
+        --broker 127.0.0.1:9092 --state 127.0.0.1:6379 \
+        --checkpoint-dir /tmp/rtfd/checkpoints &
+    echo "stream-job  pid $!"
+    python -m realtime_fraud_detection_tpu serve \
+        --host 127.0.0.1 --port 8080 --state 127.0.0.1:6379 &
+    echo "scorer      pid $! :8080"
+    echo ">> stack up; run ./scripts/health-check.sh, then ./scripts/start-simulation.sh"
+else
+    command -v docker >/dev/null || { echo "docker not found; use --local"; exit 1; }
+    docker compose -f docker-compose.yml up --build -d \
+        broker state stream-job scorer prometheus grafana
+    docker compose -f docker-compose.yml ps
+    echo ""
+    echo "Service URLs:"
+    echo "  scoring API   http://localhost:8080  (/health /predict /metrics)"
+    echo "  prometheus    http://localhost:9090"
+    echo "  grafana       http://localhost:3000"
+    echo "  broker        localhost:9092 (framework wire protocol)"
+    echo "  state         localhost:6379 (Redis protocol)"
+    echo ">> next: ./scripts/start-simulation.sh"
+fi

@@ -1,0 +1,20 @@
+#!/bin/bash
+# Drive the transaction simulator (reference start-simulation.sh analog,
+# same knobs: --tps/--users/--merchants/--brokers; reference defaults
+# TPS=100 USERS=10000 MERCHANTS=5000, start-simulation.sh:15-17).
+set -euo pipefail
+TPS=100; USERS=10000; MERCHANTS=5000; BROKER="127.0.0.1:9092"; COUNT=0
+while [[ $# -gt 0 ]]; do
+  case $1 in
+    --tps) TPS="$2"; shift 2 ;;
+    --users) USERS="$2"; shift 2 ;;
+    --merchants) MERCHANTS="$2"; shift 2 ;;
+    --brokers|--broker) BROKER="$2"; shift 2 ;;
+    --count) COUNT="$2"; shift 2 ;;
+    *) echo "unknown flag $1"; exit 2 ;;
+  esac
+done
+echo ">> simulating: tps=$TPS users=$USERS merchants=$MERCHANTS -> $BROKER"
+exec python -m realtime_fraud_detection_tpu simulate \
+    --broker "$BROKER" --tps "$TPS" --users "$USERS" \
+    --merchants "$MERCHANTS" --count "$COUNT"

@@ -1,0 +1,15 @@
+#!/bin/bash
+# Stop the stack (reference scripts/setup/stop-all.sh analog).
+#   ./stop-all.sh             # compose stack down
+#   ./stop-all.sh --local     # kill localhost processes
+#   ./stop-all.sh --wipe      # compose down + volumes (reference -v path)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+case "${1:-}" in
+  --local) pkill -f "realtime_fraud_detection_tpu (broker|state-server|run-job|serve|simulate)" || true
+           echo ">> local processes stopped" ;;
+  --wipe)  docker compose -f docker-compose.yml down -v
+           echo ">> stack + volumes removed" ;;
+  *)       docker compose -f docker-compose.yml down
+           echo ">> stack stopped (volumes kept; --wipe to remove)" ;;
+esac

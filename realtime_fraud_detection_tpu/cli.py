@@ -374,9 +374,18 @@ def cmd_state_server(args: argparse.Namespace) -> int:
     RedisService-role process N scorer replicas share. Blocks until SIGINT."""
     from realtime_fraud_detection_tpu.state.resp import MiniRedisServer
 
-    server = MiniRedisServer(host=args.host, port=args.port).start()
-    print(f"state server (RESP) listening on {args.host}:{server.port}",
-          file=sys.stderr)
+    replica_of = None
+    if args.replica_of:
+        host, _, port = args.replica_of.rpartition(":")
+        replica_of = (host, int(port))
+    server = MiniRedisServer(
+        host=args.host, port=args.port,
+        maxmemory=args.maxmemory, policy=args.policy,
+        aof_path=args.aof or None, replica_of=replica_of,
+    ).start()
+    role = "replica" if server.is_replica else "master"
+    print(f"state server (RESP, {role}) listening on "
+          f"{args.host}:{server.port}", file=sys.stderr)
     try:
         threading_event_wait()
     finally:
@@ -408,12 +417,28 @@ def cmd_health_check(args: argparse.Namespace) -> int:
 
 
 def cmd_topics(args: argparse.Namespace) -> int:
-    """Print the topic contract (create-topics.sh:101-160 analog)."""
+    """Print the topic contract; with --broker --create, materialize it on
+    a running broker (create-topics.sh:101-160 analog)."""
     from realtime_fraud_detection_tpu.stream.topics import TOPIC_SPECS
 
+    broker = None
+    if getattr(args, "create", False):
+        if not args.broker:
+            print("--create requires --broker host:port", file=sys.stderr)
+            return 2
+        from realtime_fraud_detection_tpu.stream.netbroker import (
+            NetBrokerClient,
+        )
+
+        host, _, port = args.broker.rpartition(":")
+        broker = NetBrokerClient(host=host or "127.0.0.1", port=int(port))
     for t in TOPIC_SPECS:
         flag = " compacted" if t.compacted else ""
-        print(f"{t.name:28s} partitions={t.partitions}{flag}")
+        if broker is not None:
+            broker.create_topic(t.name, t.partitions)
+            print(f"created {t.name:28s} partitions={t.partitions}{flag}")
+        else:
+            print(f"{t.name:28s} partitions={t.partitions}{flag}")
     return 0
 
 
@@ -487,6 +512,16 @@ def build_parser() -> argparse.ArgumentParser:
                         help="run the shared state server (Redis protocol)")
     sp.add_argument("--host", default="0.0.0.0")
     sp.add_argument("--port", type=int, default=6379)
+    sp.add_argument("--maxmemory", type=int, default=1 << 30,
+                    help="eviction threshold in bytes (0 = unlimited; "
+                         "default 1 GiB like the reference redis-master.conf)")
+    sp.add_argument("--policy", default="allkeys-lru",
+                    choices=["allkeys-lru", "noeviction"])
+    sp.add_argument("--aof", default="",
+                    help="append-only persistence file (empty = volatile)")
+    sp.add_argument("--replica-of", default="",
+                    help="host:port of the primary to replicate from "
+                         "(read-only replica; promote by restarting without)")
     sp.set_defaults(fn=cmd_state_server)
 
     sp = sub.add_parser("bench", help="run the TPU benchmark")
@@ -498,6 +533,10 @@ def build_parser() -> argparse.ArgumentParser:
     sp.set_defaults(fn=cmd_health_check)
 
     sp = sub.add_parser("topics", help="print the topic contract")
+    sp.add_argument("--broker", default="",
+                    help="broker host:port to create the topics on")
+    sp.add_argument("--create", action="store_true",
+                    help="materialize the contract on --broker")
     sp.set_defaults(fn=cmd_topics)
     return p
 
