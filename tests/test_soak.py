@@ -103,3 +103,116 @@ class TestSoak:
         normal = [p.value["fraud_probability"] for p in preds
                   if not labels[p.value["transaction_id"]]]
         assert np.mean(fraud) > np.mean(normal) + 0.02
+
+
+def test_multiprocess_group_failover_no_record_loss():
+    """VERDICT r3 item 6 'done' criterion: two real StreamJob WORKER
+    PROCESSES in one consumer group over the Kafka wire protocol; one is
+    SIGKILLed mid-stream. The survivor adopts the dead worker's partitions
+    from committed offsets: every transaction ends up scored (nothing
+    lost), and duplicate predictions are bounded by the dead worker's
+    uncommitted tail (at-least-once; a kill landing between fan-out and
+    offset commit legitimately replays that window — cross-process
+    exactly-once would need the shared state tier or a transactional
+    outbox, asserted elsewhere via test_shared_state.py)."""
+    import os
+    import subprocess
+    import sys
+    import time
+
+    from realtime_fraud_detection_tpu.stream.kafka import KafkaBroker
+    from realtime_fraud_detection_tpu.stream.kafka_fake import FakeKafkaServer
+
+    server = FakeKafkaServer(port=0).start()
+    worker_src = r"""
+import sys, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+from realtime_fraud_detection_tpu.scoring import FraudScorer, ScorerConfig
+from realtime_fraud_detection_tpu.sim.simulator import TransactionGenerator
+from realtime_fraud_detection_tpu.stream import JobConfig, StreamJob
+from realtime_fraud_detection_tpu.stream.kafka import KafkaBroker
+
+port = int(sys.argv[1])
+broker = KafkaBroker(bootstrap=f"127.0.0.1:{port}")
+
+class GroupBroker:
+    def __getattr__(self, k): return getattr(broker, k)
+    def consumer(self, topics, group_id, faults=None):
+        return broker.consumer(topics, group_id, group_managed=True)
+
+gen = TransactionGenerator(num_users=40, num_merchants=15, seed=101)
+scorer = FraudScorer(scorer_config=ScorerConfig(text_len=32))
+scorer.seed_profiles(gen.users.profiles(), gen.merchants.profiles())
+job = StreamJob(GroupBroker(), scorer,
+                JobConfig(max_batch=16, max_delay_ms=5.0))
+job.consumer.membership.session_timeout_ms = 2000
+print("READY", flush=True)
+deadline = time.time() + 120
+while time.time() < deadline:
+    batch = job.assembler.next_batch(block=False)
+    if not batch:
+        batch = job.assembler.flush()
+    if batch:
+        job.process_batch(batch, now=1000.0)
+        print(f"SCORED {job.counters['scored']}", flush=True)
+    else:
+        time.sleep(0.05)
+"""
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=os.pathsep.join(sys.path))
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+
+    def spawn():
+        return subprocess.Popen(
+            [sys.executable, "-c", worker_src, str(server.port)],
+            env=env, stdout=subprocess.PIPE, text=True, bufsize=1)
+
+    w1 = spawn()
+    try:
+        assert w1.stdout.readline().strip() == "READY"
+        w2 = spawn()
+        assert w2.stdout.readline().strip() == "READY"
+
+        prod = KafkaBroker(bootstrap=f"127.0.0.1:{server.port}",
+                           idempotent=True)
+        gen = TransactionGenerator(num_users=40, num_merchants=15, seed=101)
+        records = gen.generate_batch(120)
+        prod.produce_batch(T.TRANSACTIONS, records,
+                           key_fn=lambda r: str(r["user_id"]))
+
+        # let w1 score a couple of batches, then kill it hard
+        for _ in range(2):
+            line = w1.stdout.readline()
+            if not line.startswith("SCORED"):
+                break
+        w1.kill()                     # SIGKILL: no LeaveGroup, no commit
+        w1.wait(timeout=10)
+
+        # wait until the predictions topic covers every transaction id
+        check = KafkaBroker(bootstrap=f"127.0.0.1:{server.port}")
+        want = {str(r["transaction_id"]) for r in records}
+        seen: list = []
+        deadline = time.time() + 90
+        consumer = check.consumer([T.PREDICTIONS], "verify")
+        while time.time() < deadline:
+            seen.extend(r.value["transaction_id"] for r in consumer.poll(500))
+            if set(seen) >= want:
+                break
+            time.sleep(0.25)
+        w2.kill()
+        assert set(seen) >= want, (
+            f"lost {len(want - set(seen))} of {len(want)} transactions")
+        # duplicates may only come from w1's uncommitted tail (one batch
+        # window, max_batch=16 + one in-flight batch), never wholesale
+        n_dups = len(seen) - len(set(seen))
+        assert n_dups <= 32, (
+            f"{n_dups} duplicate predictions — more than the uncommitted "
+            "tail can explain; replay fencing is broken")
+        prod.close()
+        check.close()
+    finally:
+        for p in (w1, w2):
+            if p.poll() is None:
+                p.kill()
+        server.stop()
