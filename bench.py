@@ -510,15 +510,31 @@ def run_bench() -> None:
         scorer.seed_profiles(gen.users.profiles(), gen.merchants.profiles())
         job = StreamJob(broker, scorer,
                         JobConfig(max_batch=256, emit_features=False))
-        n_txn = 20_000 if on_tpu else 3_000
-        broker.produce_batch(T.TRANSACTIONS, gen.generate_batch(n_txn),
-                             key_fn=lambda r: str(r["user_id"]))
-        t0 = time.perf_counter()
-        scored = job.run_until_drained(now=1000.0)
-        dt = time.perf_counter() - t0
+        if on_tpu:
+            # sustained soak (VERDICT r3 item 5): pre-fill well past what
+            # the chip can score in the window so the job never starves,
+            # then run_for a fixed wall-clock window — sustained txn/s,
+            # not a drain of a finite backlog
+            soak_s = 30.0
+            _log('e2e soak: generating backlog')
+            for _ in range(12):
+                broker.produce_batch(
+                    T.TRANSACTIONS, gen.generate_batch(20_000),
+                    key_fn=lambda r: str(r["user_id"]))
+            t0 = time.perf_counter()
+            scored = job.run_for(soak_s)
+            dt = time.perf_counter() - t0
+        else:
+            broker.produce_batch(T.TRANSACTIONS, gen.generate_batch(3_000),
+                                 key_fn=lambda r: str(r["user_id"]))
+            t0 = time.perf_counter()
+            scored = job.run_until_drained(now=1000.0)
+            dt = time.perf_counter() - t0
         e2e_stream = {
             "txn_per_s": round(scored / dt, 1),
             "scored": scored,
+            "window_s": round(dt, 1),
+            "sustained": bool(on_tpu),
             "batches": job.counters["batches"],
         }
     except Exception as e:

@@ -9,6 +9,10 @@ from realtime_fraud_detection_tpu.parallel.context import (  # noqa: F401
     bert_context_parallel_predict,
     ring_attention,
 )
+from realtime_fraud_detection_tpu.parallel.pipeline import (  # noqa: F401
+    pipeline_forward,
+    stack_stage_params,
+)
 from realtime_fraud_detection_tpu.parallel.layouts import (  # noqa: F401
     batch_shardings,
     bert_param_specs,

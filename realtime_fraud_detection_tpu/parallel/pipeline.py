@@ -1,0 +1,118 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over a mesh axis.
+
+The reference scales only by data parallelism (Flink parallelism 12,
+SURVEY.md §2.8); this framework adds pipeline parallelism as a first-class
+mesh axis so models deeper than one chip's HBM (or latency budget) split by
+LAYER SPAN instead of by tensor. Design, TPU-first:
+
+- Stage parameters are stacked on a leading ``[n_stages, ...]`` dim and
+  sharded over the pipeline axis — each device materializes only its own
+  span's weights (1/S of the model).
+- The schedule is a single ``lax.scan`` inside ``shard_map``: every tick,
+  each device runs its stage on the activation it holds, then the
+  activations rotate one hop along the ring via ``ppermute`` — the same
+  compute/ICI-overlap pattern as ring attention (parallel/context.py), with
+  the pipeline bubble (S-1 idle ticks) amortized by M microbatches.
+- The last stage's outputs are replicated with a ``psum`` over the axis
+  (every other device contributes zeros), so callers get a full [M, ...]
+  result on every device — composable with data parallelism on ``data``.
+- The whole schedule is differentiable (scan + ppermute have transposes),
+  so ``jax.grad`` through ``pipeline_forward`` yields 1B1F-style reverse
+  scheduling from XLA with no hand-written backward pass.
+
+No counterpart exists in the reference; the contract here is numerical
+equivalence with the sequential layer stack (tests/test_parallel.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from realtime_fraud_detection_tpu.core.mesh import MODEL_AXIS
+from realtime_fraud_detection_tpu.parallel.collectives import shard_map_over
+
+__all__ = ["pipeline_forward", "stack_stage_params", "PIPELINE_AXIS"]
+
+# default pipeline axis: reuse the ``model`` mesh axis — tensor and pipeline
+# parallelism partition the same weight dimension budget, pick per model
+PIPELINE_AXIS = MODEL_AXIS
+
+
+def stack_stage_params(per_stage_params: list) -> Any:
+    """[p_0, ..., p_{S-1}] pytrees -> one pytree with leading stage dim S.
+
+    The result is what ``pipeline_forward`` shards over the pipeline axis
+    (each device holds rows of its own stage only)."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs, axis=0), *per_stage_params)
+
+
+def pipeline_forward(
+    mesh: Mesh,
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_params: Any,
+    microbatches: jax.Array,
+    axis: str = PIPELINE_AXIS,
+) -> jax.Array:
+    """Run ``stage_fn`` S times over each of M microbatches, pipelined.
+
+    mesh:        mesh containing ``axis`` (size S = number of stages)
+    stage_fn:    (params_for_one_stage, h [mb, ...]) -> h' [mb, ...]
+                 (activation shape must be stage-invariant)
+    stage_params: pytree with leading dim S (see ``stack_stage_params``)
+    microbatches: [M, mb, ...] input microbatches (replicated over ``axis``)
+
+    Returns [M, mb, ...] outputs, replicated over ``axis``. Total ticks =
+    M + S - 1; efficiency = M / (M + S - 1), so use M >= 4*S in earnest.
+    """
+    n_stages = mesh.shape[axis]
+    n_micro = microbatches.shape[0]
+
+    def device_body(params, mb):
+        # params: [1, ...] (own stage's rows), mb: [M, mb, ...] (replicated)
+        my_params = jax.tree.map(lambda x: x[0], params)
+        stage = jax.lax.axis_index(axis)
+        is_first = stage == 0
+        is_last = stage == n_stages - 1
+        zero = jnp.zeros_like(mb[0])
+        outputs0 = jnp.zeros_like(mb)
+
+        def tick(carry, t):
+            incoming, outputs = carry
+            # stage 0 injects microbatch t while t < M; later stages use
+            # the activation that arrived over the ring last tick
+            inj = jax.lax.dynamic_index_in_dim(
+                mb, jnp.minimum(t, n_micro - 1), axis=0, keepdims=False)
+            h_in = jnp.where(is_first, inj, incoming)
+            h_out = stage_fn(my_params, h_in)
+            # the last stage banks its result at slot t-(S-1) once the
+            # pipeline has filled; everyone else banks zeros (psum later)
+            slot = t - (n_stages - 1)
+            valid = is_last & (slot >= 0) & (slot < n_micro)
+            outputs = jax.lax.dynamic_update_index_in_dim(
+                outputs,
+                jnp.where(valid, h_out, jax.lax.dynamic_index_in_dim(
+                    outputs, jnp.maximum(slot, 0), axis=0, keepdims=False)),
+                jnp.maximum(slot, 0), axis=0)
+            # rotate activations one hop down the pipeline ring
+            nxt = jax.lax.ppermute(
+                h_out, axis,
+                [(i, (i + 1) % n_stages) for i in range(n_stages)])
+            return (nxt, outputs), None
+
+        (_, outputs), _ = jax.lax.scan(
+            tick, (zero, outputs0), jnp.arange(n_micro + n_stages - 1))
+        # replicate the last stage's banked outputs to every stage device
+        return jax.lax.psum(
+            jnp.where(is_last, outputs, jnp.zeros_like(outputs)), axis)
+
+    in_specs = (
+        jax.tree.map(lambda _: P(axis), stage_params),
+        P(),                                     # microbatches replicated
+    )
+    return shard_map_over(
+        mesh, device_body, in_specs=in_specs, out_specs=P(),
+    )(stage_params, microbatches)

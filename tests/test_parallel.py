@@ -182,3 +182,88 @@ def test_bert_context_parallel_matches_single_device():
     got = np.asarray(bert_context_parallel_predict(
         mesh, params, ids, mask, TINY_CONFIG))
     np.testing.assert_allclose(got, expect, rtol=2e-4, atol=2e-4)
+
+
+# ---------------------------------------------------------- pipeline parallel
+
+
+class TestPipelineParallel:
+    @staticmethod
+    def _stage_fn(params, h):
+        w, b = params["w"], params["b"]
+        return jax.nn.relu(h @ w + b)
+
+    def _setup(self, n_stages=4, n_micro=8, mb=4, dim=16, seed=0):
+        from realtime_fraud_detection_tpu.parallel.pipeline import (
+            stack_stage_params,
+        )
+
+        rng = np.random.default_rng(seed)
+        per_stage = [
+            {"w": jnp.asarray(rng.normal(0, 0.3, (dim, dim)), jnp.float32),
+             "b": jnp.asarray(rng.normal(0, 0.1, (dim,)), jnp.float32)}
+            for _ in range(n_stages)
+        ]
+        stacked = stack_stage_params(per_stage)
+        x = jnp.asarray(rng.normal(0, 1, (n_micro, mb, dim)), jnp.float32)
+        return per_stage, stacked, x
+
+    def _sequential(self, per_stage, x):
+        h = x
+        for p in per_stage:
+            h = jax.vmap(lambda m: self._stage_fn(p, m))(h)
+        return h
+
+    def test_matches_sequential(self):
+        from realtime_fraud_detection_tpu.parallel.pipeline import (
+            pipeline_forward,
+        )
+
+        per_stage, stacked, x = self._setup()
+        mesh = build_mesh(MeshConfig(model=4))      # data=2 x pipe=4
+        got = jax.jit(lambda p, xx: pipeline_forward(
+            mesh, self._stage_fn, p, xx))(stacked, x)
+        want = self._sequential(per_stage, x)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_grad_flows_through_schedule(self):
+        """jax.grad through the scan+ppermute schedule must equal the
+        sequential model's gradients (the backward pipeline comes from the
+        transpose, no hand-written schedule)."""
+        from realtime_fraud_detection_tpu.parallel.pipeline import (
+            pipeline_forward,
+        )
+
+        per_stage, stacked, x = self._setup(n_micro=6)
+        mesh = build_mesh(MeshConfig(model=4))
+
+        def loss_pipe(p):
+            out = pipeline_forward(mesh, self._stage_fn, p, x)
+            return jnp.mean(out ** 2)
+
+        def loss_seq(p_list):
+            return jnp.mean(self._sequential(p_list, x) ** 2)
+
+        g_pipe = jax.jit(jax.grad(loss_pipe))(stacked)
+        g_seq = jax.grad(loss_seq)(per_stage)
+        for s in range(4):
+            np.testing.assert_allclose(
+                np.asarray(g_pipe["w"][s]), np.asarray(g_seq[s]["w"]),
+                rtol=1e-4, atol=1e-5)
+            np.testing.assert_allclose(
+                np.asarray(g_pipe["b"][s]), np.asarray(g_seq[s]["b"]),
+                rtol=1e-4, atol=1e-5)
+
+    def test_eight_stage_pure_pipeline(self):
+        from realtime_fraud_detection_tpu.parallel.pipeline import (
+            pipeline_forward,
+        )
+
+        per_stage, stacked, x = self._setup(n_stages=8, n_micro=16)
+        mesh = build_mesh(MeshConfig(data=1, model=8))
+        got = jax.jit(lambda p, xx: pipeline_forward(
+            mesh, self._stage_fn, p, xx))(stacked, x)
+        want = self._sequential(per_stage, x)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)

@@ -181,10 +181,22 @@ while time.time() < deadline:
         prod.produce_batch(T.TRANSACTIONS, records,
                            key_fn=lambda r: str(r["user_id"]))
 
-        # let w1 score a couple of batches, then kill it hard
-        for _ in range(2):
+        # let w1 score a couple of batches, then kill it hard. The reads
+        # are select-bounded: partition skew can leave w1 with few records,
+        # and a blocking readline would stall the test for the worker's
+        # whole internal deadline.
+        import select
+
+        deadline = time.time() + 30
+        scored_lines = 0
+        while scored_lines < 2 and time.time() < deadline:
+            ready, _, _ = select.select([w1.stdout], [], [], 1.0)
+            if not ready:
+                continue
             line = w1.stdout.readline()
-            if not line.startswith("SCORED"):
+            if line.startswith("SCORED"):
+                scored_lines += 1
+            elif not line:
                 break
         w1.kill()                     # SIGKILL: no LeaveGroup, no commit
         w1.wait(timeout=10)
