@@ -9,6 +9,12 @@ from realtime_fraud_detection_tpu.parallel.context import (  # noqa: F401
     bert_context_parallel_predict,
     ring_attention,
 )
+from realtime_fraud_detection_tpu.parallel.experts import (  # noqa: F401
+    MoEConfig,
+    init_moe_params,
+    moe_ffn,
+    moe_ffn_reference,
+)
 from realtime_fraud_detection_tpu.parallel.pipeline import (  # noqa: F401
     pipeline_forward,
     stack_stage_params,

@@ -1,0 +1,173 @@
+"""Expert parallelism: mixture-of-experts FFN with all_to_all dispatch.
+
+Completes the framework's named-parallelism inventory (dp/tp/sp/pp/ep —
+SURVEY.md §2.8 lists the reference's only axis, data parallelism). The
+reference has no MoE; this exists so a sparse scoring branch (e.g. per
+merchant-category expert FFNs) scales by adding chips without growing
+per-chip FLOPs, the standard TPU recipe:
+
+- E experts' weights are stacked [E, ...] and sharded over the ``model``
+  axis: each device materializes E/S experts.
+- Tokens are sharded over ``data`` AND, within each data row, sliced over
+  the expert axis (each device routes only n/(data*S) tokens — adding
+  expert shards divides per-chip routing and FFN work instead of
+  replicating it). Each device buckets its token slice per EXPERT with a
+  fixed capacity slot count (static shapes — XLA-friendly); one
+  ``all_to_all`` over the expert axis moves the buckets onto the devices
+  that own the experts, where they run as E/S resident batched matmuls
+  (weights never replicated per token); a second all_to_all brings the
+  outputs home and an ``all_gather`` restores the full data-row shard.
+- Tokens over capacity are DROPPED (output zero, like Switch Transformer):
+  capacity_factor trades quality for the static bound.
+
+Numerics contract (tests/test_parallel.py): with generous capacity the
+result equals the dense reference — every token through its top-1 expert's
+FFN scaled by its router probability.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from realtime_fraud_detection_tpu.core.mesh import DATA_AXIS, MODEL_AXIS
+from realtime_fraud_detection_tpu.parallel.collectives import shard_map_over
+
+__all__ = ["MoEConfig", "init_moe_params", "moe_ffn", "moe_ffn_reference"]
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    n_experts: int
+    d_model: int
+    d_hidden: int
+    capacity_factor: float = 1.25
+
+
+def init_moe_params(key: jax.Array, cfg: MoEConfig) -> Dict[str, jax.Array]:
+    k1, k2, k3 = jax.random.split(key, 3)
+    scale_in = 1.0 / jnp.sqrt(cfg.d_model)
+    scale_hid = 1.0 / jnp.sqrt(cfg.d_hidden)
+    return {
+        "router": jax.random.normal(
+            k1, (cfg.d_model, cfg.n_experts)) * scale_in,
+        "w1": jax.random.normal(
+            k2, (cfg.n_experts, cfg.d_model, cfg.d_hidden)) * scale_in,
+        "b1": jnp.zeros((cfg.n_experts, cfg.d_hidden)),
+        "w2": jax.random.normal(
+            k3, (cfg.n_experts, cfg.d_hidden, cfg.d_model)) * scale_hid,
+        "b2": jnp.zeros((cfg.n_experts, cfg.d_model)),
+    }
+
+
+def _expert_ffn(w1, b1, w2, b2, x):
+    return jax.nn.relu(x @ w1 + b1) @ w2 + b2
+
+
+def moe_ffn_reference(params: Dict[str, jax.Array],
+                      x: jax.Array) -> jax.Array:
+    """Dense reference: every token through its top-1 expert, no capacity
+    drops. [N, d] -> [N, d]."""
+    logits = x @ params["router"]
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert = jnp.argmax(logits, axis=-1)                      # [N]
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=1)[:, 0]
+    all_out = jax.vmap(
+        lambda w1, b1, w2, b2: _expert_ffn(w1, b1, w2, b2, x)
+    )(params["w1"], params["b1"], params["w2"], params["b2"])  # [E, N, d]
+    picked = jnp.take_along_axis(
+        all_out, expert[None, :, None], axis=0)[0]             # [N, d]
+    return picked * gate[:, None]
+
+
+def moe_ffn(mesh: Mesh, params: Dict[str, jax.Array], x: jax.Array,
+            cfg: MoEConfig, axis: str = MODEL_AXIS) -> jax.Array:
+    """Expert-parallel MoE FFN. x: [N, d] sharded over ``data``; expert
+    weights sharded over ``axis``. Returns [N, d], same sharding as x."""
+    n_shards = mesh.shape[axis]
+    if cfg.n_experts % n_shards != 0:
+        raise ValueError(
+            f"n_experts={cfg.n_experts} not divisible by the "
+            f"{axis}-axis size {n_shards}")
+    experts_per_shard = cfg.n_experts // n_shards
+    n_per_row = x.shape[0] // mesh.shape[DATA_AXIS]
+    if n_per_row % n_shards != 0:
+        raise ValueError(
+            f"tokens per data row ({n_per_row}) not divisible by the "
+            f"{axis}-axis size {n_shards}")
+
+    def device_body(p, xs):
+        # p: expert weights for THIS shard ([E/S, ...]; router replicated)
+        # xs: [n_local, d] the data-row token shard (replicated over the
+        #     expert axis — immediately sliced so each expert-shard device
+        #     routes only its n_local/S piece)
+        n_local, d = xs.shape
+        n_sub = n_local // n_shards
+        my_row = jax.lax.axis_index(axis)
+        xs = jax.lax.dynamic_slice_in_dim(xs, my_row * n_sub, n_sub, 0)
+        # per-expert capacity per source device
+        cap = max(1, int(cfg.capacity_factor * n_sub / cfg.n_experts))
+        e_local = experts_per_shard
+
+        logits = xs @ p["router"]                             # [n_sub, E]
+        probs = jax.nn.softmax(logits, axis=-1)
+        expert = jnp.argmax(logits, axis=-1)                  # [n_sub]
+        gate = jnp.take_along_axis(probs, expert[:, None], axis=1)[:, 0]
+
+        # slot of each token within its expert's bucket (stable order);
+        # tokens past the capacity are dropped (output exactly zero)
+        onehot = jax.nn.one_hot(expert, cfg.n_experts, dtype=jnp.int32)
+        slot = (jnp.cumsum(onehot, axis=0) - 1)               # [n_sub, E]
+        my_slot = jnp.take_along_axis(
+            slot, expert[:, None], axis=1)[:, 0]              # [n_sub]
+        keep = my_slot < cap
+
+        # scatter tokens into the [E, cap] dispatch buffer; dropped tokens
+        # get an out-of-bounds index and mode="drop" discards the write —
+        # kept tokens have unique slots, so the scatter is deterministic
+        flat_idx = expert * cap + my_slot
+        scatter_idx = jnp.where(keep, flat_idx, cfg.n_experts * cap)
+        disp = (jnp.zeros((cfg.n_experts * cap, d), xs.dtype)
+                .at[scatter_idx].set(xs, mode="drop", unique_indices=True))
+
+        # all_to_all by destination shard: shard s owns experts
+        # [s*E/S, (s+1)*E/S) -> send [S, e_local*cap, d]; receive the same
+        # shape where recv[j] is source device j's buckets for MY experts
+        disp = disp.reshape(n_shards, e_local * cap, d)
+        recv = jax.lax.all_to_all(disp, axis, 0, 0, tiled=False)
+
+        # regroup by local expert and run E/S RESIDENT batched matmuls —
+        # weights are never replicated per token
+        recv = recv.reshape(n_shards, e_local, cap, d)
+        by_exp = recv.transpose(1, 0, 2, 3).reshape(
+            e_local, n_shards * cap, d)                       # [E/S, K, d]
+        h = jax.nn.relu(
+            jnp.einsum("ekd,edh->ekh", by_exp, p["w1"])
+            + p["b1"][:, None, :])
+        out = (jnp.einsum("ekh,ehd->ekd", h, p["w2"])
+               + p["b2"][:, None, :])                         # [E/S, K, d]
+
+        # send results home (inverse regroup + all_to_all)
+        out = out.reshape(e_local, n_shards, cap, d).transpose(1, 0, 2, 3)
+        back = jax.lax.all_to_all(
+            out.reshape(n_shards, e_local * cap, d), axis, 0, 0,
+            tiled=False)
+        back = back.reshape(cfg.n_experts * cap, d)
+        token_out = back[jnp.where(keep, flat_idx, 0)]        # [n_sub, d]
+        mine = jnp.where(keep[:, None], token_out * gate[:, None], 0.0)
+        # restore the full data-row shard from the per-device slices
+        return jax.lax.all_gather(mine, axis, axis=0).reshape(n_local, d)
+
+    param_specs = {
+        "router": P(),
+        "w1": P(axis), "b1": P(axis), "w2": P(axis), "b2": P(axis),
+    }
+    return shard_map_over(
+        mesh, device_body,
+        in_specs=(param_specs, P(DATA_AXIS)),
+        out_specs=P(DATA_AXIS),
+    )(params, x)
