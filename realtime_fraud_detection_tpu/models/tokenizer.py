@@ -38,6 +38,11 @@ class FraudTokenizer:
         self.max_length = max_length
         self.vocab = {w: _WORD_ID_START + i for i, w in enumerate(vocabulary_words())}
         assert _WORD_ID_START + len(self.vocab) <= _HASH_ID_START
+        # memo caches for the scoring hot path: merchant/description strings
+        # are heavily templated, so whole-text rows repeat constantly, and
+        # OOV words repeat across texts (bounded: cleared when full)
+        self._text_cache: dict[str, List[int]] = {}
+        self._oov_cache: dict[str, int] = {}
 
     @staticmethod
     def preprocess(text: str) -> str:
@@ -52,13 +57,26 @@ class FraudTokenizer:
         wid = self.vocab.get(word)
         if wid is not None:
             return wid
-        span = self.vocab_size - _HASH_ID_START
-        return _HASH_ID_START + zlib.crc32(word.encode()) % span
+        wid = self._oov_cache.get(word)
+        if wid is None:
+            span = self.vocab_size - _HASH_ID_START
+            wid = _HASH_ID_START + zlib.crc32(word.encode()) % span
+            if len(self._oov_cache) >= 100_000:
+                self._oov_cache.clear()
+            self._oov_cache[word] = wid
+        return wid
 
     def encode(self, text: str) -> List[int]:
+        cached = self._text_cache.get(text)
+        if cached is not None:
+            return list(cached)     # copy: callers may mutate their row
         words = self.preprocess(text).split()
         ids = [CLS_ID] + [self._word_id(w) for w in words] + [SEP_ID]
-        return ids[: self.max_length]
+        ids = ids[: self.max_length]
+        if len(self._text_cache) >= 50_000:
+            self._text_cache.clear()
+        self._text_cache[text] = ids
+        return list(ids)
 
     def encode_batch(self, texts: Sequence[str]) -> Tuple[np.ndarray, np.ndarray]:
         """Batch to fixed (B, max_length) ids + attention mask."""
