@@ -1,0 +1,153 @@
+"""On-chip tuning sweep: run when the TPU relay is reachable.
+
+Complements bench.py (the driver's fixed-format benchmark) with the sweeps
+needed to CHOOSE the production constants (VERDICT r3 item 2 — drive p99
+under the 20 ms budget with measured numbers):
+
+1. Pallas flash-attention block sizes (block_q x block_k) at seq 64/128/512
+   vs plain XLA attention — picks ops/attention.py defaults.
+2. score_fused bucket-size sweep (64..1024): per-bucket device latency and
+   txn/s so BATCH_BUCKETS reflects the chip's actual knee.
+3. Per-branch device timings at the chosen bucket — where the p99 goes.
+
+Usage:  python tune_tpu.py            # exits 3 immediately if no TPU
+Output: one JSON line per sweep point on stdout (greppable), summary last.
+
+Timing discipline: block_until_ready before ANY device->host pull (the
+axon tunnel permanently degrades to sync mode after the first transfer —
+see .claude/skills/verify/SKILL.md).
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+
+def _probe() -> bool:
+    code = "import jax; print(jax.devices()[0].platform, flush=True)"
+    try:
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True, timeout=150)
+    except subprocess.TimeoutExpired:
+        return False
+    return proc.returncode == 0 and "cpu" not in proc.stdout
+
+def _emit(**kv) -> None:
+    print(json.dumps(kv), flush=True)
+
+
+def _time_blocked(fn, iters: int) -> dict:
+    import jax
+
+    jax.block_until_ready(fn())
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn())
+        times.append(time.perf_counter() - t0)
+    ms = np.asarray(times) * 1e3
+    return {"p50_ms": round(float(np.percentile(ms, 50)), 3),
+            "p99_ms": round(float(np.percentile(ms, 99)), 3)}
+
+
+def main() -> int:
+    if not _probe():
+        print("no TPU reachable; not running the sweep", file=sys.stderr)
+        return 3
+    import jax
+    import jax.numpy as jnp
+
+    from realtime_fraud_detection_tpu.ensemble.combine import EnsembleParams
+    from realtime_fraud_detection_tpu.models.bert import (
+        BertConfig,
+        bert_predict,
+    )
+    from realtime_fraud_detection_tpu.ops.attention import (
+        attention_reference,
+        flash_attention,
+    )
+    from realtime_fraud_detection_tpu.scoring import (
+        MODEL_NAMES,
+        ScorerConfig,
+        init_scoring_models,
+        make_example_batch,
+        score_fused,
+    )
+    from realtime_fraud_detection_tpu.utils.config import Config
+
+    dev = jax.devices()[0]
+    _emit(stage="start", device=str(dev))
+    rng = np.random.default_rng(0)
+
+    # 1 ------------------------------------------------- pallas block sweep
+    for seq in (64, 128, 512):
+        b, h, d = 64, 12, 64
+        q, k, v = (jnp.asarray(rng.standard_normal((b, h, seq, d)),
+                               jnp.float32) for _ in range(3))
+        mask = jnp.ones((b, seq), bool)
+        ref = jax.jit(lambda q, k, v, m: attention_reference(q, k, v, m))
+        base = _time_blocked(lambda: ref(q, k, v, mask), 30)
+        _emit(stage="attn", seq=seq, impl="xla", **base)
+        for bq in (64, 128, 256):
+            for bk in (64, 128, 256):
+                if seq % bq or seq % bk:
+                    continue
+                try:
+                    t = _time_blocked(
+                        lambda: flash_attention(q, k, v, mask,
+                                                block_q=bq, block_k=bk), 30)
+                except Exception as e:  # noqa: BLE001
+                    _emit(stage="attn", seq=seq, impl="pallas", block_q=bq,
+                          block_k=bk, error=str(e)[:120])
+                    continue
+                _emit(stage="attn", seq=seq, impl="pallas", block_q=bq,
+                      block_k=bk, **t)
+
+    # 2 ---------------------------------------------------- bucket sweep
+    bert_config = BertConfig()
+    sc = ScorerConfig(text_len=64)
+    models = jax.device_put(init_scoring_models(
+        jax.random.PRNGKey(0), bert_config=bert_config,
+        feature_dim=sc.feature_dim, node_dim=sc.node_dim))
+    params = EnsembleParams.from_config(Config(), list(MODEL_NAMES))
+    valid = jnp.ones((len(MODEL_NAMES),), bool)
+    fused = jax.jit(lambda m, b, p, v: score_fused(
+        m, b, p, v, bert_config=bert_config, with_model_preds=False))
+    for bucket in (64, 128, 256, 512, 1024):
+        batch = jax.device_put(make_example_batch(
+            bucket, sc, rng=np.random.default_rng(bucket)))
+        t = _time_blocked(lambda: fused(models, batch, params, valid), 40)
+        _emit(stage="bucket", bucket=bucket,
+              txn_per_s=round(bucket / (t["p50_ms"] / 1e3), 1), **t)
+
+    # 3 ------------------------------------------------ per-branch split
+    from realtime_fraud_detection_tpu.models.isolation_forest import (
+        iforest_predict,
+    )
+    from realtime_fraud_detection_tpu.models.lstm import lstm_logits
+    from realtime_fraud_detection_tpu.models.trees import tree_ensemble_predict
+
+    batch = jax.device_put(make_example_batch(
+        256, sc, rng=np.random.default_rng(1)))
+    branches = {
+        "trees": jax.jit(lambda: tree_ensemble_predict(
+            models.trees, batch.features)),
+        "iforest": jax.jit(lambda: iforest_predict(
+            models.iforest, batch.features)),
+        "lstm": jax.jit(lambda: jax.nn.sigmoid(lstm_logits(
+            models.lstm, batch.history, batch.history_len))),
+        "bert": jax.jit(lambda: bert_predict(
+            models.bert, batch.token_ids, batch.token_mask, bert_config)),
+    }
+    for name, fn in branches.items():
+        _emit(stage="branch", branch=name, batch=256, **_time_blocked(fn, 30))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
