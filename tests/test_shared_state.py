@@ -337,3 +337,68 @@ def test_replication_snapshot_stream_and_failover():
     finally:
         cr.close()
         replica.stop()
+
+
+def test_concurrent_load_with_eviction_replication_aof(tmp_path):
+    """Stress the new production machinery together: N client threads
+    hammer a bounded AOF-backed primary while a replica SYNCs mid-stream
+    and evictions run. Invariants: no deadlock/timeouts, primary stays
+    responsive, memory stays under the cap, counters converge on the
+    replica, and a restart replays to the same live keys."""
+    import threading
+
+    aof = str(tmp_path / "stress.aof")
+    primary = MiniRedisServer(maxmemory=150_000, aof_path=aof).start()
+    errors: list = []
+
+    def hammer(tid: int):
+        try:
+            c = RespClient(port=primary.port, timeout_s=10.0)
+            for i in range(300):
+                c.set(f"t{tid}:k{i}", "v" * 50)
+                c.hincrby("shared:counter", f"t{tid}", 1)
+                c.lpush(f"t{tid}:list", str(i))
+                c.ltrim(f"t{tid}:list", 0, 9)
+                if i % 50 == 0:
+                    c.get(f"t{tid}:k{i}")
+                    c.dbsize()
+            c.close()
+        except Exception as e:  # noqa: BLE001
+            errors.append(f"t{tid}: {type(e).__name__}: {e}")
+
+    threads = [threading.Thread(target=hammer, args=(t,)) for t in range(8)]
+    for t in threads:
+        t.start()
+    # attach a replica while the write storm is running
+    replica = MiniRedisServer(replica_of=("127.0.0.1", primary.port)).start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors, errors
+    assert not any(t.is_alive() for t in threads), "hammer thread hung"
+
+    c = RespClient(port=primary.port)
+    assert primary.used_memory <= 150_000
+    assert primary.evicted_keys > 0                  # cap actually bound
+    counts = c.hgetall("shared:counter")
+    assert {int(v) for v in counts.values()} == {300}   # atomic increments
+
+    # replica converges on the final counter hash
+    cr = RespClient(port=replica.port)
+    assert _wait_for(
+        lambda: cr.hgetall("shared:counter") == counts, timeout_s=10.0)
+
+    # restart from AOF: the same live keyspace comes back
+    live_before = c.dbsize()
+    counter_before = c.hgetall("shared:counter")
+    c.close()
+    primary.stop()
+    restarted = MiniRedisServer(aof_path=aof).start()
+    c2 = RespClient(port=restarted.port)
+    try:
+        assert c2.hgetall("shared:counter") == counter_before
+        assert c2.dbsize() == live_before
+    finally:
+        c2.close()
+        cr.close()
+        restarted.stop()
+        replica.stop()
