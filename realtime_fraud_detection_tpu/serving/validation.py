@@ -13,11 +13,58 @@ from __future__ import annotations
 import math
 from typing import Any, Dict, List, Mapping, Tuple
 
-__all__ = ["validate_transaction", "validate_batch"]
+__all__ = ["validate_transaction", "validate_batch", "sanitize_for_stream"]
 
 _REQUIRED = ("transaction_id", "user_id", "merchant_id", "amount")
 _STRING_FIELDS = ("transaction_id", "user_id", "merchant_id", "currency",
                   "payment_method", "timestamp")
+
+# stream-ingest coercion tables (the encode path's typed accessors)
+_STREAM_INT_FIELDS = ("hour_of_day", "day_of_week", "day_of_month")
+_STREAM_FLOAT_FIELDS = ("fraud_score",)
+_STREAM_GEO_FIELDS = ("geolocation", "merchant_location")
+_STREAM_STR_FIELDS = ("payment_method", "transaction_type", "card_type",
+                      "user_agent", "ip_address", "device_fingerprint",
+                      "description")
+
+
+def sanitize_for_stream(body: Any) -> Tuple[Dict[str, Any], List[str]]:
+    """Per-record ingest sanitizer for the stream path.
+
+    The reference degrades per TRANSACTION, not per batch
+    (TransactionProcessor.java:83-91 wraps each processElement); a poisoned
+    field in one record must not push its 255 batch-mates onto the error
+    path. Strict on identity + amount (reject), lenient on everything else
+    (coerce or drop the field so the encoder's defaults apply). Returns
+    (sanitized_record, errors); non-empty errors == divert this record to
+    the per-record error result."""
+    txn, errors = validate_transaction(body)
+    if errors:
+        return txn, errors
+    for f in _STREAM_INT_FIELDS:
+        if f in txn:
+            try:
+                txn[f] = int(txn[f])
+            except (TypeError, ValueError):
+                del txn[f]
+    for f in _STREAM_FLOAT_FIELDS:
+        if f in txn:
+            try:
+                v = float(txn[f])
+                txn[f] = v if math.isfinite(v) else 0.0
+            except (TypeError, ValueError):
+                del txn[f]
+    for f in _STREAM_GEO_FIELDS:
+        geo = txn.get(f)
+        if geo is not None:
+            try:
+                txn[f] = {"lat": float(geo["lat"]), "lon": float(geo["lon"])}
+            except (TypeError, ValueError, KeyError):
+                del txn[f]
+    for f in _STREAM_STR_FIELDS:
+        if f in txn and txn[f] is not None and not isinstance(txn[f], str):
+            txn[f] = str(txn[f])
+    return txn, []
 
 
 def validate_transaction(body: Any) -> Tuple[Dict[str, Any], List[str]]:

@@ -24,6 +24,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 from realtime_fraud_detection_tpu.scoring.scorer import FraudScorer
+from realtime_fraud_detection_tpu.serving.validation import sanitize_for_stream
 from realtime_fraud_detection_tpu.state.stores import _event_time_ms
 from realtime_fraud_detection_tpu.stream import topics as T
 from realtime_fraud_detection_tpu.stream.microbatch import MicrobatchAssembler
@@ -63,6 +64,9 @@ class _BatchCtx:
     pending: Any                      # scoring.scorer.PendingScore | None
     positions: Dict[tuple, int]       # offsets to commit at completion
     now: Optional[float]
+    # records rejected by per-record ingest sanitization; each gets its own
+    # error result at completion — they never poison the rest of the batch
+    invalid: List[tuple] = dataclasses.field(default_factory=list)
 
 
 class StreamJob:
@@ -123,9 +127,17 @@ class StreamJob:
         if not records:
             return None
         fresh: List[Record] = []
+        invalid: List[tuple] = []
         batch_ids: set = set()
         for r in records:
-            txn_id = str(r.value.get("transaction_id", f"{r.partition}:{r.offset}"))
+            txn, errors = sanitize_for_stream(r.value)
+            if errors:
+                # per-record degradation (TransactionProcessor.java:83-91):
+                # one poisoned record must not drag its batch-mates onto
+                # the error path — it alone gets an error result
+                invalid.append((r, errors))
+                continue
+            txn_id = txn["transaction_id"]  # sanitizer guarantees non-empty
             if (txn_id in batch_ids  # duplicate within this very batch
                     or txn_id in self._inflight_ids  # in a dispatched batch
                     or self.scorer.txn_cache.get_transaction(txn_id, now=now)
@@ -133,27 +145,28 @@ class StreamJob:
                 self.counters["duplicates_skipped"] += 1  # replay/dup dedupe
                 continue
             batch_ids.add(txn_id)
-            fresh.append(r)
+            fresh.append(dataclasses.replace(r, value=txn))
         positions = self.consumer.snapshot_positions()
         if not fresh:
-            return _BatchCtx([], set(), None, positions, now)
+            return _BatchCtx([], set(), None, positions, now, invalid)
         pending = None
         try:
             pending = self.scorer.dispatch([r.value for r in fresh], now=now)
         except Exception:
-            # degradation path (TransactionProcessor.java:83-91): score 0.5,
-            # REVIEW, keep the stream alive; counted at completion
+            # whole-batch degradation fallback: score 0.5, REVIEW, keep the
+            # stream alive; counted at completion
             pass
         self._inflight_ids |= batch_ids
-        return _BatchCtx(fresh, batch_ids, pending, positions, now)
+        return _BatchCtx(fresh, batch_ids, pending, positions, now, invalid)
 
     def complete_batch(self, ctx: "_BatchCtx") -> List[Dict[str, Any]]:
         """Stage 2: block on the device result, fan out, commit offsets."""
         cfg = self.config
         fresh, now = ctx.fresh, ctx.now
         if not fresh:
+            invalid_results = self._emit_invalid(ctx)  # no ids at risk
             self.consumer.commit(ctx.positions)
-            return []
+            return invalid_results
 
         scored_ok, results, feats = False, None, None
         if ctx.pending is not None:
@@ -181,7 +194,11 @@ class StreamJob:
             ]
 
         try:
-            return self._fan_out(ctx, fresh, results, feats, scored_ok, now)
+            # inside the protective try: a produce failure here must release
+            # the in-flight ids like any other fan-out failure
+            invalid_results = self._emit_invalid(ctx)
+            return invalid_results + self._fan_out(
+                ctx, fresh, results, feats, scored_ok, now)
         finally:
             # ALWAYS release, even when fan-out raises mid-way (broker down):
             # a leaked id makes the replayed record look like an in-flight
@@ -190,6 +207,30 @@ class StreamJob:
             # released, an uncommitted batch replays and rescans normally
             # (txn-cache dedupe still guards the already-written-back case).
             self._inflight_ids -= ctx.ids
+
+    def _emit_invalid(self, ctx: "_BatchCtx") -> List[Dict[str, Any]]:
+        """Per-record error results for sanitization rejects: produced to
+        the predictions topic so downstream sees a REVIEW decision, never a
+        silent gap. Covered by this batch's offset commit."""
+        results = []
+        for rec, errors in ctx.invalid:
+            value = rec.value if isinstance(rec.value, dict) else {}
+            res = {
+                "transaction_id": str(value.get("transaction_id", "")),
+                "fraud_probability": 0.5,
+                "fraud_score": 0.5,
+                "risk_level": "ERROR",
+                "decision": "REVIEW",
+                "model_predictions": {},
+                "confidence": 0.0,
+                "processing_time_ms": 0.0,
+                "explanation": {"error": True, "validation_errors": errors},
+            }
+            self.counters["errors"] += 1
+            self.broker.produce(T.PREDICTIONS, res,
+                                key=str(value.get("user_id", "")))
+            results.append(res)
+        return results
 
     def _fan_out(self, ctx: "_BatchCtx", fresh: List[Record],
                  results: List[Dict[str, Any]], feats, scored_ok: bool,

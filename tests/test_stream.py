@@ -282,3 +282,36 @@ def test_topic_contract_mirrors_reference():
                      "reporting-data", "merchant-transactions",
                      "fraud-metrics", "transaction-metrics"):
         assert expected in by_name, expected
+
+
+def test_poisoned_record_degrades_alone_not_the_batch():
+    """Per-record degradation (TransactionProcessor.java:83-91): one record
+    with a malformed amount must get its own REVIEW error result while its
+    batch-mates score normally — not drag the whole batch onto the error
+    path."""
+    gen = TransactionGenerator(num_users=20, num_merchants=10, seed=37)
+    broker = InMemoryBroker()
+    scorer = FraudScorer(scorer_config=ScorerConfig(text_len=32))
+    scorer.seed_profiles(gen.users.profiles(), gen.merchants.profiles())
+    job = StreamJob(broker, scorer, JobConfig(max_batch=16))
+    records = gen.generate_batch(10)
+    records[3] = dict(records[3], amount="not-a-number")
+    records[7] = dict(records[7], geolocation="garbage",  # coerced, scores
+                      hour_of_day="NaNish")
+    broker.produce_batch(T.TRANSACTIONS, records,
+                         key_fn=lambda r: str(r["user_id"]))
+    scored = job.run_until_drained(now=1000.0)
+    assert scored == 9                       # record 3 diverted, 7 coerced
+    assert job.counters["errors"] == 1
+    preds = broker.consumer([T.PREDICTIONS], "check").poll(100)
+    assert len(preds) == 10                  # nothing silently dropped
+    by_id = {r.value["transaction_id"]: r.value for r in preds}
+    bad = by_id[str(records[3]["transaction_id"])]
+    assert bad["decision"] == "REVIEW" and bad["risk_level"] == "ERROR"
+    assert "validation_errors" in bad["explanation"]
+    ok = by_id[str(records[7]["transaction_id"])]
+    assert ok["risk_level"] != "ERROR"       # coercion, not rejection
+    good_scores = [v for k, v in by_id.items()
+                   if k != str(records[3]["transaction_id"])]
+    assert all(v["risk_level"] != "ERROR" for v in good_scores)
+    assert broker.lag(job.config.group_id, T.TRANSACTIONS) == 0
