@@ -19,8 +19,12 @@ _REQUIRED = ("transaction_id", "user_id", "merchant_id", "amount")
 _STRING_FIELDS = ("transaction_id", "user_id", "merchant_id", "currency",
                   "payment_method", "timestamp")
 
-# stream-ingest coercion tables (the encode path's typed accessors)
-_STREAM_INT_FIELDS = ("hour_of_day", "day_of_week", "day_of_month")
+# stream-ingest coercion tables (the encode path's typed accessors);
+# calendar fields carry their valid ranges — an out-of-range value (found
+# by fuzzing: 2**31 passes int() but overflows the int32 batch column) is
+# dropped so the encoder's neutral default applies
+_STREAM_INT_FIELDS = (("hour_of_day", 0, 23), ("day_of_week", 1, 7),
+                      ("day_of_month", 1, 31))
 _STREAM_FLOAT_FIELDS = ("fraud_score",)
 _STREAM_GEO_FIELDS = ("geolocation", "merchant_location")
 _STREAM_STR_FIELDS = ("payment_method", "transaction_type", "card_type",
@@ -41,11 +45,16 @@ def sanitize_for_stream(body: Any) -> Tuple[Dict[str, Any], List[str]]:
     txn, errors = validate_transaction(body)
     if errors:
         return txn, errors
-    for f in _STREAM_INT_FIELDS:
+    for f, lo, hi in _STREAM_INT_FIELDS:
         if f in txn:
             try:
-                txn[f] = int(txn[f])
+                v = int(txn[f])
             except (TypeError, ValueError):
+                del txn[f]
+                continue
+            if lo <= v <= hi:
+                txn[f] = v
+            else:
                 del txn[f]
     for f in _STREAM_FLOAT_FIELDS:
         if f in txn:

@@ -393,3 +393,60 @@ class TestEnrichment:
             assert "ensemble_score" in r.value       # pre-blend score kept
             assert 0.0 <= r.value["fraud_score"] <= 1.0
             assert r.value["decision"] in ("APPROVE", "REVIEW", "DECLINE")
+
+
+class TestIngestFuzz:
+    """Property: NO input shape may crash the sanitize -> encode path.
+
+    The stream ingests arbitrary JSON from the wire; a crash in assembly is
+    a whole-batch degradation, so the sanitizer must turn any garbage into
+    either a clean reject or an encodable record."""
+
+    @staticmethod
+    def _strategies():
+        from hypothesis import strategies as st
+
+        scalar = st.one_of(
+            st.none(), st.booleans(), st.integers(-10**12, 10**12),
+            st.floats(allow_nan=True, allow_infinity=True), st.text(max_size=20),
+            st.lists(st.integers(), max_size=3),
+        )
+        geo = st.one_of(
+            scalar,
+            st.fixed_dictionaries({}, optional={
+                "lat": scalar, "lon": scalar}),
+        )
+        return st.fixed_dictionaries({}, optional={
+            "transaction_id": scalar, "user_id": scalar,
+            "merchant_id": scalar, "amount": scalar,
+            "hour_of_day": scalar, "day_of_week": scalar,
+            "day_of_month": scalar, "is_weekend": scalar,
+            "geolocation": geo, "merchant_location": geo,
+            "payment_method": scalar, "transaction_type": scalar,
+            "card_type": scalar, "user_agent": scalar,
+            "ip_address": scalar, "device_fingerprint": scalar,
+            "description": scalar, "fraud_score": scalar,
+            "timestamp": scalar, "unexpected_field": scalar,
+        })
+
+    def test_sanitize_then_encode_never_crashes(self):
+        from hypothesis import given, settings
+
+        from realtime_fraud_detection_tpu.features.schema import (
+            encode_transactions,
+        )
+        from realtime_fraud_detection_tpu.serving.validation import (
+            sanitize_for_stream,
+        )
+
+        @given(self._strategies())
+        @settings(max_examples=300, deadline=None)
+        def check(rec):
+            txn, errors = sanitize_for_stream(rec)
+            if errors:
+                return                      # clean reject is a valid outcome
+            batch = encode_transactions([txn])
+            assert batch.batch_size == 1
+            assert float(batch.amount[0]) >= 0.0
+
+        check()
