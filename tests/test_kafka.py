@@ -545,3 +545,65 @@ def test_golden_record_batch_v2_full_bytes():
         + after_crc
     )
     assert got == expected
+
+
+def test_group_membership_churn_no_deadlock():
+    """Members joining and leaving repeatedly while others poll must never
+    deadlock the membership lock / background heartbeat thread, and the
+    group must converge to full coverage after the churn stops."""
+    from realtime_fraud_detection_tpu.stream.kafka_group import (
+        KafkaGroupConsumer,
+    )
+
+    server = FakeKafkaServer(port=0).start()
+    stable_b = _group_broker(server)
+    try:
+        stable = KafkaGroupConsumer(stable_b, [T.TRANSACTIONS], "g-churn",
+                                    session_timeout_ms=2000,
+                                    heartbeat_interval_s=0.1)
+        stop = time.monotonic() + 6.0
+        errors: list = []
+
+        def churner(n: int):
+            try:
+                while time.monotonic() < stop:
+                    b = _group_broker(server)
+                    c = KafkaGroupConsumer(b, [T.TRANSACTIONS], "g-churn",
+                                           session_timeout_ms=2000,
+                                           heartbeat_interval_s=0.1)
+                    c.poll(5)
+                    time.sleep(0.1)
+                    c.close()
+                    b.close()
+            except Exception as e:  # noqa: BLE001
+                errors.append(f"churner {n}: {type(e).__name__}: {e}")
+
+        churners = [threading.Thread(target=churner, args=(i,))
+                    for i in range(3)]
+        for t in churners:
+            t.start()
+        # the stable member keeps polling through the churn
+        while time.monotonic() < stop:
+            stable.poll(5)
+            time.sleep(0.05)
+        for t in churners:
+            t.join(timeout=30)
+        assert not any(t.is_alive() for t in churners), "churner hung"
+        assert not errors, errors
+
+        # after the churn: stable member reconverges to ALL partitions
+        n_parts = stable_b.partitions(T.TRANSACTIONS)
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            stable.poll(5)
+            owned = set(stable.assigned_partitions().get(T.TRANSACTIONS, []))
+            if owned == set(range(n_parts)):
+                break
+            time.sleep(0.1)
+        assert set(stable.assigned_partitions()[T.TRANSACTIONS]) == \
+            set(range(n_parts))
+        assert stable.membership.rebalances >= 2
+        stable.close()
+    finally:
+        stable_b.close()
+        server.stop()
