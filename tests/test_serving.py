@@ -371,3 +371,62 @@ class TestEndpoints:
         assert resp.status == 400
         resp.read()
         conn.close()
+
+
+def test_serving_app_on_shared_state_tier():
+    """The compose/k8s topology: a serving replica wired to the shared RESP
+    tier (serve --state). A /predict must score AND write its txn-cache +
+    velocity state through the wire so the next replica sees it."""
+    import asyncio
+
+    from realtime_fraud_detection_tpu.scoring import FraudScorer, ScorerConfig
+    from realtime_fraud_detection_tpu.serving import ServingApp
+    from realtime_fraud_detection_tpu.state import MiniRedisServer, RespClient
+    from realtime_fraud_detection_tpu.utils.config import Config
+
+    state = MiniRedisServer().start()
+    config = Config()
+    config.serving.prediction_timeout_seconds = 180.0
+    scorer = FraudScorer(config, scorer_config=ScorerConfig(text_len=32),
+                         state_client=RespClient(port=state.port))
+    app = ServingApp(config, host="127.0.0.1", port=0, scorer=scorer)
+    gen = TransactionGenerator(num_users=32, num_merchants=16, seed=41)
+    app.scorer.seed_profiles(gen.users.profiles(), gen.merchants.profiles())
+
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+
+    def run():
+        asyncio.set_event_loop(loop)
+
+        async def _start():
+            await app.start()
+            started.set()
+
+        loop.run_until_complete(_start())
+        loop.run_forever()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert started.wait(timeout=30)
+    try:
+        txn = gen.generate_batch(1)[0]
+        status, data = _request(app.port, "POST", "/predict", txn)
+        assert status == 200
+        assert 0.0 <= data["fraud_probability"] <= 1.0
+        # the shared tier holds this replica's write-back
+        c = RespClient(port=state.port)
+        keys = [k.decode() for k in c.keys("*")]
+        tid = str(txn["transaction_id"])
+        assert any(tid in k for k in keys), keys[:10]
+        assert any("velocity" in k or "vel" in k for k in keys), keys[:10]
+        # a FRESH scorer (second replica) dedupes against the shared cache
+        s2 = FraudScorer(config, scorer_config=ScorerConfig(text_len=32),
+                         state_client=RespClient(port=state.port))
+        assert s2.txn_cache.get_transaction(tid) is not None
+        c.close()
+    finally:
+        asyncio.run_coroutine_threadsafe(app.stop(), loop).result(timeout=10)
+        loop.call_soon_threadsafe(loop.stop)
+        t.join(timeout=5)
+        state.stop()
