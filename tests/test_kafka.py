@@ -607,3 +607,28 @@ def test_group_membership_churn_no_deadlock():
     finally:
         stable_b.close()
         server.stop()
+
+
+def test_fetch_large_backlog_across_polls():
+    """A backlog far larger than one fetch response (4 MiB cap, truncated
+    tail per Kafka semantics) must stream completely and in order across
+    successive polls."""
+    server = FakeKafkaServer(port=0).start()
+    b = KafkaBroker(bootstrap=f"127.0.0.1:{server.port}")
+    try:
+        big = "x" * 64_000                       # ~64 KB per record value
+        n = 200                                  # ~12.8 MB total, 4 MiB cap
+        b.produce_batch(T.TRANSACTIONS, [{"n": i, "pad": big}
+                                         for i in range(n)],
+                        key_fn=lambda v: "one-key")   # single partition
+        c = b.consumer([T.TRANSACTIONS], "g-big")
+        seen = []
+        for _ in range(50):
+            recs = c.poll(500)
+            if not recs:
+                break
+            seen.extend(r.value["n"] for r in recs)
+        assert seen == list(range(n))            # complete and ordered
+    finally:
+        b.close()
+        server.stop()
