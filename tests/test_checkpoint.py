@@ -155,3 +155,45 @@ class TestJobRecovery:
         # every replayed txn was already in the restored cache
         assert job2.counters["duplicates_skipped"] == 32
         assert job2.counters["scored"] == 0
+
+
+def test_checkpoint_offsets_from_group_managed_consumer(tmp_path):
+    """The checkpoint manifest must capture a group-managed consumer's
+    positions in the same 'topic:partition' form as the static consumer,
+    so resume works regardless of the assignment mode."""
+    from realtime_fraud_detection_tpu.checkpoint import CheckpointManager
+    from realtime_fraud_detection_tpu.stream import topics as T
+    from realtime_fraud_detection_tpu.stream.kafka import KafkaBroker
+    from realtime_fraud_detection_tpu.stream.kafka_fake import FakeKafkaServer
+    from realtime_fraud_detection_tpu.stream.kafka_group import (
+        KafkaGroupConsumer,
+    )
+
+    server = FakeKafkaServer(port=0).start()
+    b = KafkaBroker(bootstrap=f"127.0.0.1:{server.port}")
+    try:
+        b.produce_batch(T.TRANSACTIONS, [{"n": i} for i in range(30)],
+                        key_fn=lambda v: str(v["n"]))
+        c = KafkaGroupConsumer(b, [T.TRANSACTIONS], "g-ckpt",
+                               session_timeout_ms=5000,
+                               heartbeat_interval_s=0.5)
+        recs = c.poll(30)
+        assert recs
+        c.commit()
+        positions = c.positions()
+        assert positions and all(":" in k for k in positions)
+        mgr = CheckpointManager(tmp_path)
+        mgr.save(1, offsets=positions)
+        ck = mgr.restore()
+        assert ck.offsets == positions
+        # a fresh member of the group resumes exactly from those offsets
+        c.close()
+        c2 = KafkaGroupConsumer(b, [T.TRANSACTIONS], "g-ckpt",
+                                session_timeout_ms=5000,
+                                heartbeat_interval_s=0.5)
+        assert c2.positions() == ck.offsets
+        assert c2.poll(100) == []
+        c2.close()
+    finally:
+        b.close()
+        server.stop()
