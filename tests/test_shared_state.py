@@ -402,3 +402,55 @@ def test_concurrent_load_with_eviction_replication_aof(tmp_path):
         cr.close()
         restarted.stop()
         replica.stop()
+
+
+def test_aof_rewrite_under_concurrent_writes(tmp_path):
+    """rewrite_aof() while clients are writing: nothing lost, appends keep
+    flowing to the NEW file, and a restart replays the rewritten+appended
+    log to the exact final state."""
+    import threading
+
+    aof = str(tmp_path / "rw.aof")
+    s = MiniRedisServer(aof_path=aof).start()
+    stop = threading.Event()
+    errors: list = []
+
+    def writer():
+        try:
+            c = RespClient(port=s.port, timeout_s=10.0)
+            i = 0
+            while not stop.is_set():
+                c.set(f"w:{i % 50}", f"v{i}")
+                c.hincrby("agg", "n", 1)
+                i += 1
+            c.close()
+        except Exception as e:  # noqa: BLE001
+            errors.append(f"{type(e).__name__}: {e}")
+
+    threads = [threading.Thread(target=writer) for _ in range(4)]
+    for t in threads:
+        t.start()
+    import time as _t
+
+    for _ in range(5):
+        _t.sleep(0.1)
+        s.rewrite_aof()
+    stop.set()
+    for t in threads:
+        t.join(timeout=20)
+    assert not errors, errors
+
+    c = RespClient(port=s.port)
+    final_agg = c.hgetall("agg")["n"]
+    final_db = c.dbsize()
+    c.close()
+    s.stop()
+
+    s2 = MiniRedisServer(aof_path=aof).start()
+    c2 = RespClient(port=s2.port)
+    try:
+        assert c2.hgetall("agg")["n"] == final_agg
+        assert c2.dbsize() == final_db
+    finally:
+        c2.close()
+        s2.stop()
