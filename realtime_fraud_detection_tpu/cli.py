@@ -45,30 +45,39 @@ def cmd_simulate(args: argparse.Namespace) -> int:
                                num_merchants=args.merchants,
                                seed=args.seed, tps=args.tps)
     if getattr(args, "broker", ""):
-        # produce into an external broker at ~tps (start-simulation.sh role)
-        from realtime_fraud_detection_tpu.stream import NetBrokerClient
+        # produce into an external broker at ~tps (start-simulation.sh
+        # role) through the ingress gateway: generation paces here, the
+        # gateway's C++ lock-free queue + sender thread overlaps the
+        # network produce with generation
+        from realtime_fraud_detection_tpu.stream import (
+            IngressGateway,
+            NetBrokerClient,
+        )
         from realtime_fraud_detection_tpu.stream import topics as T
 
         host, port = _addr(args.broker, 9092)
         client = NetBrokerClient(host=host, port=port)
+        gateway = IngressGateway(client, T.TRANSACTIONS)
         n_fraud = produced = 0
         try:
             while produced < args.count:
                 chunk = min(1000, args.count - produced,
                             max(1, int(args.tps)))
                 t0 = time.perf_counter()
-                records = gen.generate_batch(chunk)
-                n_fraud += sum(bool(t.get("is_fraud")) for t in records)
-                client.produce_batch(T.TRANSACTIONS, records,
-                                     key_fn=lambda r: str(r["user_id"]))
+                for txn in gen.generate_batch(chunk):
+                    n_fraud += bool(txn.get("is_fraud"))
+                    while not gateway.submit(txn):  # backpressure: spin
+                        time.sleep(0.001)
                 produced += chunk
                 budget = chunk / args.tps - (time.perf_counter() - t0)
                 if budget > 0:
                     time.sleep(budget)
         finally:
+            gateway.close()
             client.close()
-        print(f"produced {produced} txns ({n_fraud} fraud) to "
-              f"{args.broker}", file=sys.stderr)
+        print(f"produced {produced} txns ({n_fraud} fraud, "
+              f"native_queue={gateway.native}, dropped={gateway.dropped}) "
+              f"to {args.broker}", file=sys.stderr)
         return 0
     out = sys.stdout if args.output == "-" else open(args.output, "w")
     try:

@@ -21,6 +21,9 @@ from realtime_fraud_detection_tpu.stream.netbroker import (  # noqa: F401
     BrokerServer,
     NetBrokerClient,
 )
+from realtime_fraud_detection_tpu.stream.gateway import (  # noqa: F401
+    IngressGateway,
+)
 from realtime_fraud_detection_tpu.stream.microbatch import (  # noqa: F401
     DoubleBufferedScorer,
     MicrobatchAssembler,
