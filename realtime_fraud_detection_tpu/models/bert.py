@@ -118,33 +118,57 @@ def bert_encode(
     attention here; everything else in the layer is per-token and shards
     along S for free).
     """
-    b, s = input_ids.shape
-    x = params["word_emb"][input_ids] + params["pos_emb"][:s][None, :, :]
-    x = _layer_norm(x, params["emb_ln"], config.layer_norm_eps)
-
+    x = bert_embed(params, input_ids, config)
     for layer in params["layers"]:
-        q = _dense(x, layer["q"], compute_dtype)
-        k = _dense(x, layer["k"], compute_dtype)
-        v = _dense(x, layer["v"], compute_dtype)
-
-        def split(t):
-            return t.reshape(b, s, config.num_heads, config.head_dim).transpose(0, 2, 1, 3)
-
-        qh, kh, vh = split(q), split(k), split(v)
-        if attention_fn is not None:
-            ctx = attention_fn(qh, kh, vh, attention_mask)
-        elif use_pallas:
-            ctx = flash_attention(qh, kh, vh, attention_mask)
-        else:
-            ctx = attention_reference(qh, kh, vh, attention_mask)
-        ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, config.hidden_size)
-        attn_out = _dense(ctx, layer["o"], compute_dtype)
-        x = _layer_norm(x + attn_out, layer["attn_ln"], config.layer_norm_eps)
-
-        ffn = _dense(jax.nn.gelu(_dense(x, layer["ffn1"], compute_dtype)),
-                     layer["ffn2"], compute_dtype)
-        x = _layer_norm(x + ffn, layer["ffn_ln"], config.layer_norm_eps)
+        x = bert_layer(layer, x, attention_mask, config,
+                       use_pallas=use_pallas, compute_dtype=compute_dtype,
+                       attention_fn=attention_fn)
     return x
+
+
+def bert_embed(params: Dict, input_ids: jax.Array,
+               config: BertConfig) -> jax.Array:
+    """Token + position embeddings with the embedding layer norm — shared
+    by the sequential and pipeline-parallel encoders."""
+    s = input_ids.shape[1]
+    x = params["word_emb"][input_ids] + params["pos_emb"][:s][None, :, :]
+    return _layer_norm(x, params["emb_ln"], config.layer_norm_eps)
+
+
+def bert_layer(
+    layer: Dict,
+    x: jax.Array,               # f32[B, S, H]
+    attention_mask: jax.Array,  # bool[B, S]
+    config: BertConfig,
+    use_pallas: bool = False,
+    compute_dtype=jnp.bfloat16,
+    attention_fn=None,
+) -> jax.Array:
+    """One post-LN transformer block — the unit the pipeline-parallel
+    schedule (parallel/pipeline.bert_pipeline_encode) spans over stages."""
+    b, s = x.shape[:2]
+    q = _dense(x, layer["q"], compute_dtype)
+    k = _dense(x, layer["k"], compute_dtype)
+    v = _dense(x, layer["v"], compute_dtype)
+
+    def split(t):
+        return t.reshape(b, s, config.num_heads,
+                         config.head_dim).transpose(0, 2, 1, 3)
+
+    qh, kh, vh = split(q), split(k), split(v)
+    if attention_fn is not None:
+        ctx = attention_fn(qh, kh, vh, attention_mask)
+    elif use_pallas:
+        ctx = flash_attention(qh, kh, vh, attention_mask)
+    else:
+        ctx = attention_reference(qh, kh, vh, attention_mask)
+    ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, config.hidden_size)
+    attn_out = _dense(ctx, layer["o"], compute_dtype)
+    x = _layer_norm(x + attn_out, layer["attn_ln"], config.layer_norm_eps)
+
+    ffn = _dense(jax.nn.gelu(_dense(x, layer["ffn1"], compute_dtype)),
+                 layer["ffn2"], compute_dtype)
+    return _layer_norm(x + ffn, layer["ffn_ln"], config.layer_norm_eps)
 
 
 def bert_logits(

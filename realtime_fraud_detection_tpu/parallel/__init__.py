@@ -16,6 +16,7 @@ from realtime_fraud_detection_tpu.parallel.experts import (  # noqa: F401
     moe_ffn_reference,
 )
 from realtime_fraud_detection_tpu.parallel.pipeline import (  # noqa: F401
+    bert_pipeline_encode,
     pipeline_forward,
     stack_stage_params,
 )

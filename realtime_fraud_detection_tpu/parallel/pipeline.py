@@ -35,7 +35,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 from realtime_fraud_detection_tpu.core.mesh import MODEL_AXIS
 from realtime_fraud_detection_tpu.parallel.collectives import shard_map_over
 
-__all__ = ["pipeline_forward", "stack_stage_params", "PIPELINE_AXIS"]
+__all__ = ["pipeline_forward", "stack_stage_params", "bert_pipeline_encode",
+           "PIPELINE_AXIS"]
 
 # default pipeline axis: reuse the ``model`` mesh axis — tensor and pipeline
 # parallelism partition the same weight dimension budget, pick per model
@@ -60,16 +61,19 @@ def pipeline_forward(
     """Run ``stage_fn`` S times over each of M microbatches, pipelined.
 
     mesh:        mesh containing ``axis`` (size S = number of stages)
-    stage_fn:    (params_for_one_stage, h [mb, ...]) -> h' [mb, ...]
-                 (activation shape must be stage-invariant)
+    stage_fn:    (params_for_one_stage, h) -> h' where h is an array
+                 [mb, ...] or a PYTREE of arrays (e.g. (hidden, mask) so
+                 per-microbatch side inputs ride the pipeline); shapes must
+                 be stage-invariant
     stage_params: pytree with leading dim S (see ``stack_stage_params``)
-    microbatches: [M, mb, ...] input microbatches (replicated over ``axis``)
+    microbatches: pytree of [M, mb, ...] arrays (replicated over ``axis``)
 
-    Returns [M, mb, ...] outputs, replicated over ``axis``. Total ticks =
-    M + S - 1; efficiency = M / (M + S - 1), so use M >= 4*S in earnest.
+    Returns the same pytree with [M, mb, ...] outputs, replicated over
+    ``axis``. Total ticks = M + S - 1; efficiency = M / (M + S - 1), so
+    use M >= 4*S in earnest.
     """
     n_stages = mesh.shape[axis]
-    n_micro = microbatches.shape[0]
+    n_micro = jax.tree.leaves(microbatches)[0].shape[0]
 
     def device_body(params, mb):
         # params: [1, ...] (own stage's rows), mb: [M, mb, ...] (replicated)
@@ -77,26 +81,32 @@ def pipeline_forward(
         stage = jax.lax.axis_index(axis)
         is_first = stage == 0
         is_last = stage == n_stages - 1
-        zero = jnp.zeros_like(mb[0])
-        outputs0 = jnp.zeros_like(mb)
+        zero = jax.tree.map(lambda m: jnp.zeros_like(m[0]), mb)
+        outputs0 = jax.tree.map(jnp.zeros_like, mb)
 
         def tick(carry, t):
             incoming, outputs = carry
             # stage 0 injects microbatch t while t < M; later stages use
             # the activation that arrived over the ring last tick
-            inj = jax.lax.dynamic_index_in_dim(
-                mb, jnp.minimum(t, n_micro - 1), axis=0, keepdims=False)
-            h_in = jnp.where(is_first, inj, incoming)
+            t_idx = jnp.minimum(t, n_micro - 1)
+            inj = jax.tree.map(
+                lambda m: jax.lax.dynamic_index_in_dim(
+                    m, t_idx, axis=0, keepdims=False), mb)
+            h_in = jax.tree.map(
+                lambda a, b: jnp.where(is_first, a, b), inj, incoming)
             h_out = stage_fn(my_params, h_in)
             # the last stage banks its result at slot t-(S-1) once the
             # pipeline has filled; everyone else banks zeros (psum later)
             slot = t - (n_stages - 1)
             valid = is_last & (slot >= 0) & (slot < n_micro)
-            outputs = jax.lax.dynamic_update_index_in_dim(
-                outputs,
-                jnp.where(valid, h_out, jax.lax.dynamic_index_in_dim(
-                    outputs, jnp.maximum(slot, 0), axis=0, keepdims=False)),
-                jnp.maximum(slot, 0), axis=0)
+            slot_c = jnp.maximum(slot, 0)
+            outputs = jax.tree.map(
+                lambda o, h: jax.lax.dynamic_update_index_in_dim(
+                    o,
+                    jnp.where(valid, h, jax.lax.dynamic_index_in_dim(
+                        o, slot_c, axis=0, keepdims=False)),
+                    slot_c, axis=0),
+                outputs, h_out)
             # rotate activations one hop down the pipeline ring
             nxt = jax.lax.ppermute(
                 h_out, axis,
@@ -107,7 +117,9 @@ def pipeline_forward(
             tick, (zero, outputs0), jnp.arange(n_micro + n_stages - 1))
         # replicate the last stage's banked outputs to every stage device
         return jax.lax.psum(
-            jnp.where(is_last, outputs, jnp.zeros_like(outputs)), axis)
+            jax.tree.map(
+                lambda o: jnp.where(is_last, o, jnp.zeros_like(o)), outputs),
+            axis)
 
     in_specs = (
         jax.tree.map(lambda _: P(axis), stage_params),
@@ -116,3 +128,59 @@ def pipeline_forward(
     return shard_map_over(
         mesh, device_body, in_specs=in_specs, out_specs=P(),
     )(stage_params, microbatches)
+
+
+def bert_pipeline_encode(
+    mesh: Mesh,
+    params: Any,
+    input_ids: jax.Array,       # i32[B, S]
+    attention_mask: jax.Array,  # bool[B, S]
+    config: Any,                # models.bert.BertConfig
+    n_micro: int = 4,
+    axis: str = PIPELINE_AXIS,
+    use_pallas: bool = False,
+) -> jax.Array:
+    """DistilBERT encoder with its layers PIPELINED over ``axis``.
+
+    Each device holds ``num_layers / S`` transformer blocks; hidden states
+    (with their attention mask riding along as a pytree leaf) flow through
+    the GPipe schedule in ``n_micro`` microbatches. Embeddings and the
+    mask are computed replicated (they are ~free next to the blocks).
+    Numerics are identical to the sequential ``models.bert.bert_encode``
+    (tests/test_parallel.py pins it).
+    """
+    from realtime_fraud_detection_tpu.models.bert import (
+        bert_embed,
+        bert_layer,
+    )
+
+    n_stages = mesh.shape[axis]
+    if config.num_layers % n_stages:
+        raise ValueError(
+            f"num_layers={config.num_layers} not divisible by the "
+            f"{axis}-axis size {n_stages}")
+    span = config.num_layers // n_stages
+    b, s = input_ids.shape
+    if b % n_micro:
+        raise ValueError(f"batch {b} not divisible by n_micro={n_micro}")
+
+    x = bert_embed(params, input_ids, config)
+
+    stage_params = stack_stage_params([
+        {"layers": params["layers"][i * span:(i + 1) * span]}
+        for i in range(n_stages)
+    ])
+    mb = b // n_micro
+    micro_x = x.reshape(n_micro, mb, s, config.hidden_size)
+    micro_mask = attention_mask.reshape(n_micro, mb, s)
+
+    def stage_fn(p, h):
+        hid, mask = h
+        for layer in p["layers"]:
+            hid = bert_layer(layer, hid, mask, config,
+                             use_pallas=use_pallas)
+        return (hid, mask)
+
+    out_x, _ = pipeline_forward(
+        mesh, stage_fn, stage_params, (micro_x, micro_mask), axis=axis)
+    return out_x.reshape(b, s, config.hidden_size)

@@ -356,3 +356,31 @@ class TestExpertParallel:
             np.testing.assert_allclose(
                 np.asarray(g_pp[key]), np.asarray(g_ref[key]),
                 rtol=5e-4, atol=1e-6, err_msg=key)
+
+
+def test_bert_pipeline_encode_matches_sequential():
+    """The flagship text encoder with its layers split over pipeline
+    stages (mask riding the schedule as a pytree leaf) must match the
+    sequential encoder exactly, padding included."""
+    from realtime_fraud_detection_tpu.models.bert import (
+        TINY_CONFIG,
+        bert_encode,
+        init_bert_params,
+    )
+    from realtime_fraud_detection_tpu.parallel.pipeline import (
+        bert_pipeline_encode,
+    )
+
+    params = init_bert_params(jax.random.PRNGKey(5), TINY_CONFIG)
+    rng = np.random.default_rng(7)
+    b, s = 8, 16
+    ids = jnp.asarray(rng.integers(0, TINY_CONFIG.vocab_size, (b, s)),
+                      jnp.int32)
+    mask = jnp.asarray(rng.random((b, s)) > 0.3)
+    mask = mask.at[:, 0].set(True)            # CLS always valid
+    mesh = build_mesh(MeshConfig(model=2))    # 2 stages x data=4
+    got = jax.jit(lambda p, i, m: bert_pipeline_encode(
+        mesh, p, i, m, TINY_CONFIG, n_micro=4))(params, ids, mask)
+    want = bert_encode(params, ids, mask, TINY_CONFIG)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
