@@ -133,11 +133,7 @@ def _key_factors(txn: TransactionBatch) -> Dict[str, jax.Array]:
     }
 
 
-@partial(
-    jax.jit,
-    static_argnames=("bert_config", "use_pallas", "with_model_preds"),
-)
-def score_fused(
+def _score_fused_impl(
     models: ScoringModels,
     batch: ScoreBatch,
     params: EnsembleParams,
@@ -191,6 +187,65 @@ def score_fused(
     return out
 
 
+score_fused = partial(
+    jax.jit,
+    static_argnames=("bert_config", "use_pallas", "with_model_preds"),
+)(_score_fused_impl)
+
+
+# Column layout of the packed f32[B, len(OUT_COLUMNS) + NUM_MODELS] result
+# matrix: everything _build_responses needs, in one d2h transfer. ints and
+# bools ride as exact small floats (decision/risk are ladder indices < 4).
+OUT_COLUMNS: tuple[str, ...] = (
+    "fraud_probability", "confidence", "decision", "risk_level",
+    "rule_score", "high_amount", "unusual_hour", "high_risk_payment",
+)
+
+
+@partial(jax.jit, static_argnames=("spec", "bert_config", "use_pallas"))
+def score_fused_packed(
+    models: ScoringModels,
+    blob_f32: jax.Array,             # f32[B, Wf] — packed float leaves
+    blob_i32: jax.Array,             # i32[B, Wi] — packed int leaves
+    blob_u8: jax.Array,              # u8[B, Wb]  — packed bool leaves
+    spec,                            # static core.packing.PackSpec
+    params: EnsembleParams,
+    model_valid: jax.Array,
+    blob_bf16: Optional[jax.Array] = None,  # bf16[B, Wh] — half-width leaves
+    bert_config: BertConfig = TINY_CONFIG,
+    use_pallas: bool = False,
+) -> jax.Array:
+    """Transfer-optimal fused scorer: packed blobs in, one matrix out.
+
+    The streaming hot path on a remote TPU is bounded by transport round
+    trips, not FLOPs (bench r4: ~85 ms null RTT vs ~25 ms compute per
+    256-batch). This entry takes the microbatch as the three packed buffers
+    from ``core.packing.pack_tree`` (one h2d payload) and returns the §2.7
+    response fields as ONE f32[B, 8+M] matrix (one d2h payload) laid out per
+    ``OUT_COLUMNS`` + model_predictions. XLA fuses the unpack slices into
+    the branch consumers, so the repack costs nothing on-device.
+    """
+    from realtime_fraud_detection_tpu.core.packing import unpack_tree
+
+    blobs = {"f32": blob_f32, "i32": blob_i32, "u8": blob_u8}
+    if blob_bf16 is not None:
+        blobs["bf16"] = blob_bf16
+    batch = unpack_tree(blobs, spec)
+    # bf16 was a wire format: widen back to f32 before the branches (the
+    # cast fuses into the first consumer, costing no extra HBM traffic)
+    batch = jax.tree.map(
+        lambda x: x.astype(jnp.float32) if x.dtype == jnp.bfloat16 else x,
+        batch)
+    out = _score_fused_impl(
+        models, batch, params, model_valid,
+        bert_config=bert_config, use_pallas=use_pallas,
+        with_model_preds=True,
+    )
+    cols = [out[name].astype(jnp.float32) for name in OUT_COLUMNS]
+    return jnp.concatenate(
+        [jnp.stack(cols, axis=1), out["model_predictions"]], axis=1)
+
+
 @dataclasses.dataclass
 class ScorerConfig:
     """Static shapes for the fused scorer (one compilation per bucket)."""
@@ -201,6 +256,15 @@ class ScorerConfig:
     fanout: int = 16           # GNN neighbor fanout (last-100-txn graph analog)
     text_len: int = 64         # token length for the text branch
     use_pallas: bool = False   # Pallas flash attention (TPU only)
+    # start the result's device->host copy at dispatch time so the transfer
+    # overlaps the next batch's host work (scorer.dispatch). Tunable because
+    # transport backends differ in how they handle outstanding async copies.
+    async_d2h: bool = True
+    # ship the bulky float tensors (LSTM history + GNN node/neighbor
+    # features, ~45% of the microbatch bytes) as bf16 on the wire; widened
+    # back to f32 on-device. Off by default: it perturbs scores at bf16
+    # resolution, so it's a knob for bandwidth-bound links, not a freebie.
+    transfer_bf16: bool = False
 
 
 def make_example_batch(
@@ -209,6 +273,9 @@ def make_example_batch(
     rng: Optional[np.random.Generator] = None,
 ) -> ScoreBatch:
     """Synthetic ScoreBatch for compile-checks and benchmarks."""
+    from realtime_fraud_detection_tpu.features.extract import (
+        extract_features_host,
+    )
     from realtime_fraud_detection_tpu.features.schema import encode_transactions
     from realtime_fraud_detection_tpu.sim.simulator import TransactionGenerator
 
@@ -223,7 +290,9 @@ def make_example_batch(
     b, c = batch_size, config
     return ScoreBatch(
         txn=txn,
-        features=np.asarray(extract_features(txn)),
+        # host-backend extraction: benches/examples must not trigger a
+        # device->host pull at staging time (see extract_features_host)
+        features=extract_features_host(txn),
         history=rng.standard_normal((b, c.seq_len, c.feature_dim)).astype(np.float32),
         history_len=np.full((b,), c.seq_len, np.int32),
         user_feat=rng.standard_normal((b, c.node_dim)).astype(np.float32),

@@ -41,14 +41,17 @@ from realtime_fraud_detection_tpu.features.schema import encode_transactions
 from realtime_fraud_detection_tpu.models.bert import BertConfig, TINY_CONFIG
 from realtime_fraud_detection_tpu.models.text import combined_text
 from realtime_fraud_detection_tpu.models.tokenizer import FraudTokenizer
+from realtime_fraud_detection_tpu.core.packing import pack_tree
 from realtime_fraud_detection_tpu.scoring.pipeline import (
     MODEL_NAMES,
     NUM_MODELS,
+    OUT_COLUMNS,
     ScoreBatch,
     ScorerConfig,
     ScoringModels,
     init_scoring_models,
     score_fused,
+    score_fused_packed,
 )
 from realtime_fraud_detection_tpu.state.history import (
     EntityGraphStore,
@@ -216,6 +219,15 @@ class FraudScorer:
                       merchants: Mapping[str, Mapping[str, Any]]) -> None:
         self.profiles.seed(users, merchants)
 
+    def _model_valid_dev(self):
+        """Device copy of the branch-validity mask, re-pushed only when the
+        mask changes — not one h2d transfer per microbatch."""
+        cached = getattr(self, "_mv_cache", None)
+        mv = np.asarray(self.model_valid)
+        if cached is None or not np.array_equal(cached[0], mv):
+            self._mv_cache = (mv.copy(), jax.device_put(mv))
+        return self._mv_cache[1]
+
     # ----------------------------------------------------------------- models
     def set_models(self, models: ScoringModels) -> None:
         """Swap the model set (hot reload). Params are replicated onto this
@@ -239,9 +251,14 @@ class FraudScorer:
 
         txn = encode_transactions(records, uprofs, mprofs, velocities)
 
-        # feature history for the LSTM branch: append-then-gather semantics
-        from realtime_fraud_detection_tpu.features.extract import extract_features
-        feats = np.asarray(extract_features(txn))
+        # feature history for the LSTM branch: append-then-gather semantics.
+        # Extraction runs on the HOST backend: the rows are needed host-side
+        # regardless, and a device round trip here costs a tunnel RTT per
+        # microbatch (see extract_features_host).
+        from realtime_fraud_detection_tpu.features.extract import (
+            extract_features_host,
+        )
+        feats = extract_features_host(txn)
         self.last_features = feats  # host copy for feature-topic fan-out
         history, history_len = self.history.append_and_gather(user_ids, feats)
 
@@ -310,13 +327,41 @@ class FraudScorer:
         )
         # pad rows replicate row 0's True flag; the real mask is the padder's
         padded = padded.replace(valid=mask)
-        sharded = shard_batch(self.mesh, padded)
+        # Transfer-optimal seam (core/packing.py): the 65-leaf ScoreBatch
+        # collapses to 3 dense blobs (one h2d payload), the program returns
+        # ONE f32 matrix (one d2h payload) — on a remote TPU the hot loop
+        # pays transport round trips, not FLOPs, so the transfer count is
+        # the latency budget.
+        if self.sc.transfer_bf16:
+            import ml_dtypes
 
-        out = score_fused(
-            self.models, sharded, self.ensemble_params,
-            jax.device_put(self.model_valid),
+            bf = ml_dtypes.bfloat16
+            padded = padded.replace(
+                history=np.asarray(padded.history, bf),
+                user_feat=np.asarray(padded.user_feat, bf),
+                merchant_feat=np.asarray(padded.merchant_feat, bf),
+                user_neigh_feat=np.asarray(padded.user_neigh_feat, bf),
+                merch_neigh_feat=np.asarray(padded.merch_neigh_feat, bf),
+            )
+        blobs, spec = pack_tree(padded)
+        sharded = shard_batch(self.mesh, blobs)
+
+        out = score_fused_packed(
+            self.models, sharded["f32"], sharded["i32"], sharded["u8"],
+            spec=spec, params=self.ensemble_params,
+            model_valid=self._model_valid_dev(),
+            blob_bf16=sharded["bf16"],
             bert_config=self.bert_config, use_pallas=self.sc.use_pallas,
         )
+        # Start the device->host copy NOW (it queues behind the compute):
+        # by the time finalize() calls device_get, the transfer is already
+        # in flight or done, so the d2h RTT overlaps the next batch's
+        # assemble instead of serializing after it.
+        if self.sc.async_d2h:
+            try:
+                out.copy_to_host_async()
+            except AttributeError:  # backend without async copy support
+                pass
         return PendingScore(records=list(records), n=n, out=out,
                             features=self.last_features,
                             dispatch_ms=(time.perf_counter() - t0) * 1000.0)
@@ -354,15 +399,19 @@ class FraudScorer:
         return self.finalize(self.dispatch(records, now), now)
 
     def _build_responses(self, records, out, n, elapsed_ms) -> List[Dict[str, Any]]:
-        probs = np.asarray(out["fraud_probability"])[:n]
-        conf = np.asarray(out["confidence"])[:n]
-        decisions = np.asarray(out["decision"])[:n]
-        risk = np.asarray(out["risk_level"])[:n]
-        preds = np.asarray(out["model_predictions"])[:n]
-        rule = np.asarray(out["rule_score"])[:n]
-        high_amount = np.asarray(out["high_amount"])[:n]
-        unusual_hour = np.asarray(out["unusual_hour"])[:n]
-        high_risk_payment = np.asarray(out["high_risk_payment"])[:n]
+        # ``out`` is the packed f32[B, 8+M] matrix from score_fused_packed:
+        # OUT_COLUMNS then per-model predictions (one d2h transfer's worth).
+        mat = np.asarray(out)[:n]
+        col = {name: mat[:, j] for j, name in enumerate(OUT_COLUMNS)}
+        probs = col["fraud_probability"]
+        conf = col["confidence"]
+        decisions = col["decision"].astype(np.int32)
+        risk = col["risk_level"].astype(np.int32)
+        preds = mat[:, len(OUT_COLUMNS):]
+        rule = col["rule_score"]
+        high_amount = col["high_amount"] > 0.5
+        unusual_hour = col["unusual_hour"] > 0.5
+        high_risk_payment = col["high_risk_payment"] > 0.5
         per_txn_ms = elapsed_ms / max(n, 1)
 
         results = []
