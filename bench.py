@@ -177,7 +177,7 @@ def orchestrate() -> None:
     if result is None:
         _log("falling back to clean CPU backend")
         try:
-            result = _run_inner(_cpu_env(), timeout_s=900.0)
+            result = _run_inner(_cpu_env(), timeout_s=1500.0)
         except Exception as e:  # noqa: BLE001
             errors.append(f"cpu fallback failed: {type(e).__name__}: {e}"[:300])
             _log(errors[-1])
@@ -620,9 +620,6 @@ def run_bench() -> None:
     e2e_stream = {}
     quality = {}
     try:
-        from realtime_fraud_detection_tpu.features.extract import (
-            extract_features_host,
-        )
         from realtime_fraud_detection_tpu.scoring import FraudScorer
         from realtime_fraud_detection_tpu.sim.simulator import (
             TransactionGenerator,
@@ -635,18 +632,53 @@ def run_bench() -> None:
         from realtime_fraud_detection_tpu.stream import topics as T
         from realtime_fraud_detection_tpu.training import GBDTTrainer
 
+        from realtime_fraud_detection_tpu.models.isolation_forest import (
+            IsolationForestTrainer,
+        )
+        from realtime_fraud_detection_tpu.scoring import MODEL_NAMES as _MN
+
         gen = TransactionGenerator(num_users=2000, num_merchants=500, seed=3)
-        _log('e2e soak: training trees')
-        train_batch, train_labels = gen.generate_encoded(6000)
-        trees = GBDTTrainer(n_estimators=40, max_depth=5, seed=2).fit(
-            extract_features_host(train_batch),
-            train_labels["is_fraud"].astype(np.float32))
-        models = models.replace(trees=trees)
         broker = InMemoryBroker()
         scorer = FraudScorer(
             models=models, scorer_config=sc, bert_config=bert_config)
         scorer.sc.use_pallas = use_pallas
         scorer.seed_profiles(gen.users.profiles(), gen.merchants.profiles())
+
+        # Train on STREAMED features: run the training transactions through
+        # the production assemble path (live velocity/history/graph state)
+        # so the trees see the distribution they will score — training on
+        # offline-encoded features costs ~2pp accuracy / ~0.04 AUC on the
+        # stream (r4 measurement). assemble() is host-only, so this phase
+        # costs no device time. The reference never wired its trainer to
+        # its stream at all (SURVEY.md §0.3).
+        _log('e2e soak: streaming training features')
+        tr_feats, tr_labels = [], []
+        for _ in range(48):
+            recs = gen.generate_batch(256)
+            b = scorer.assemble(recs)
+            tr_feats.append(np.asarray(b.features))
+            tr_labels.append(np.asarray(
+                [bool(r.get("is_fraud")) for r in recs], np.float32))
+            ts = time.time()
+            for r in recs:
+                scorer.velocity.update(str(r.get("user_id", "")),
+                                       float(r.get("amount", 0.0)), ts)
+        x_tr = np.concatenate(tr_feats)
+        y_tr = np.concatenate(tr_labels)
+        _log('e2e soak: fitting trees + isolation forest')
+        trees = GBDTTrainer(n_estimators=40, max_depth=5, seed=2).fit(
+            x_tr, y_tr)
+        iforest = IsolationForestTrainer(n_estimators=100, seed=4).fit(
+            x_tr[y_tr < 0.5][:6000])
+        scorer.set_models(models.replace(trees=trees, iforest=iforest))
+        # Production blend: the untrained neural branches stay ENABLED on
+        # device (they execute in the fused program — the throughput number
+        # is the full 5-branch program) but are masked out of the score
+        # blend via the per-branch validity feature (§2.2) exactly as a
+        # deployment would gate cold models; weights renormalize to the
+        # trained branches.
+        for name in ("lstm_sequential", "bert_text", "graph_neural"):
+            scorer.model_valid[list(_MN).index(name)] = False
         job = StreamJob(broker, scorer,
                         JobConfig(max_batch=256, emit_features=False,
                                   pipeline_depth=3))
@@ -718,6 +750,9 @@ def run_bench() -> None:
                 "accuracy": round(float((flag == pos).mean()), 4),
                 "precision": round(tp / max(int(flag.sum()), 1), 4),
                 "recall": round(tp / max(n_pos, 1), 4),
+                "blend": "trees+iforest trained on streamed features; "
+                         "untrained neural branches execute on device but "
+                         "are blend-masked (per-branch validity, §2.2)",
                 "reference_claim": "96.8% accuracy, unmeasured "
                                    "(reference README.md:203)",
             }

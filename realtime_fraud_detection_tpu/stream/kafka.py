@@ -30,9 +30,11 @@ Production semantics (reference config/kafka/*.properties):
   partition rebalance on member death (stream/kafka_group.py).
 
 Scope notes (deliberate, documented):
-- Messages are uncompressed (attributes=0): no lz4 codec exists in this
-  image's stdlib. The app-layer payloads are small JSON dicts; compression
-  is a deployment knob, not a semantic.
+- ``compression="gzip"`` on the RecordBatch v2 producer path mirrors the
+  reference's ``compression.type=lz4`` (producer.properties:11) with the
+  codec this image's stdlib provides — lz4 has none; codec choice is
+  per-batch in the protocol. The legacy v1 message-set path (non-idempotent
+  producers) stays uncompressed.
 - Exactly-once is the framework's own offset/dedupe protocol (commit after
   fan-out + txn-cache dedupe, stream/job.py), not Kafka transactions.
 """
@@ -210,10 +212,30 @@ def decode_message_set(buf: bytes) -> List[Tuple[int, Optional[bytes], Optional[
 
     A Fetch response may end with a truncated message (Kafka semantics);
     the incomplete tail is dropped. CRC is verified per message.
+
+    Handles what a real broker can hand a Fetch v2 consumer:
+    - plain v0/v1 messages;
+    - a gzip WRAPPER message (codec bits 1): its value is itself an encoded
+      message set holding the batch — the down-converted form of this
+      client's own gzip RecordBatch v2 produces. The wrapper's offset is
+      the offset of the LAST inner message (v1 semantics); inner relative
+      offsets are rebased accordingly;
+    - a raw RecordBatch v2 (magic=2) if the broker skips down-conversion.
     """
     out: List[Tuple[int, Optional[bytes], Optional[bytes], int]] = []
     r = Reader(buf)
     while r.remaining() >= 12:
+        # magic=2 batches are not framed as [offset][size][message]: peek
+        # the magic byte at its fixed RecordBatch position (offset 16)
+        if r.remaining() >= 17 and r.buf[r.pos + 16] == 2:
+            base = r.pos
+            _off, size = struct.unpack_from(">qi", r.buf, base)
+            if r.remaining() < 12 + size:
+                break                  # truncated trailing batch
+            batch = r._take(12 + size)
+            recs, _pid, _pe, _seq = decode_record_batch(batch)
+            out.extend(recs)
+            continue
         offset = r.i64()
         size = r.i32()
         if r.remaining() < size:
@@ -225,13 +247,25 @@ def decode_message_set(buf: bytes) -> List[Tuple[int, Optional[bytes], Optional[
             raise ValueError(f"bad CRC in message at offset {offset}")
         magic = msg.i8()
         attributes = msg.i8()
-        if attributes & 0x07:
-            raise NotImplementedError(
-                "compressed message sets not supported (no codec in image)")
+        codec = attributes & 0x07
         ts = msg.i64() if magic >= 1 else -1
         key = msg.bytes_()
         value = msg.bytes_()
-        out.append((offset, key, value, ts))
+        if codec == 0:
+            out.append((offset, key, value, ts))
+            continue
+        if codec != 1 or value is None:
+            raise NotImplementedError(
+                f"unsupported message-set codec {codec} (gzip only)")
+        import gzip as _gzip
+
+        inner = decode_message_set(_gzip.decompress(value))
+        # v1 wrapper offset = offset of the LAST inner message; inner
+        # offsets are 0..n-1 relative
+        last_rel = inner[-1][0] if inner else 0
+        for rel, ik, iv, its in inner:
+            out.append((offset - last_rel + rel, ik, iv,
+                        its if its != -1 else ts))
     return out
 
 
@@ -291,9 +325,17 @@ def _read_varint(buf: bytes, pos: int) -> Tuple[int, int]:
 def encode_record_batch(
     messages: Sequence[Tuple[Optional[bytes], Optional[bytes], int]],
     producer_id: int = -1, producer_epoch: int = -1,
-    base_sequence: int = -1,
+    base_sequence: int = -1, compression: Optional[str] = None,
 ) -> bytes:
-    """[(key, value, timestamp_ms)] -> RecordBatch v2 bytes."""
+    """[(key, value, timestamp_ms)] -> RecordBatch v2 bytes.
+
+    ``compression="gzip"`` gzips the records section and sets the batch
+    attributes codec bits (codec 1) — the v2 analog of the reference's
+    ``compression.type`` producer setting (producer.properties:11; the
+    reference uses lz4, whose codec has no stdlib implementation here, so
+    this client speaks gzip — codec negotiation is per-batch in the
+    protocol, brokers accept any supported codec).
+    """
     first_ts = messages[0][2]
     max_ts = max(m[2] for m in messages)
     records = bytearray()
@@ -311,11 +353,19 @@ def encode_record_batch(
         _write_varint(body, 0)                    # headers
         _write_varint(records, len(body))
         records.extend(body)
+    if compression is None:
+        attrs, records_wire = 0, bytes(records)
+    elif compression == "gzip":
+        import gzip as _gzip
+
+        attrs, records_wire = 1, _gzip.compress(bytes(records), mtime=0)
+    else:
+        raise ValueError(f"unsupported compression codec: {compression}")
     after_crc = (
-        struct.pack(">hiqqqhii", 0, len(messages) - 1, first_ts, max_ts,
+        struct.pack(">hiqqqhii", attrs, len(messages) - 1, first_ts, max_ts,
                     producer_id, producer_epoch, base_sequence,
                     len(messages))
-        + bytes(records)
+        + records_wire
     )
     crc = crc32c(after_crc)
     tail = struct.pack(">ibI", -1, 2, crc) + after_crc   # leaderEpoch, magic
@@ -333,27 +383,36 @@ def decode_record_batch(buf: bytes) -> Tuple[
     after_crc = buf[21:]
     if crc32c(after_crc) != crc:
         raise ValueError("bad CRC32C in record batch")
-    (_attrs, _last_delta, first_ts, _max_ts, pid, pepoch, base_seq,
+    (attrs, _last_delta, first_ts, _max_ts, pid, pepoch, base_seq,
      count) = struct.unpack_from(">hiqqqhii", after_crc)
-    pos = struct.calcsize(">hiqqqhii")
+    hdr_end = struct.calcsize(">hiqqqhii")
+    codec = attrs & 0x07
+    if codec == 0:
+        recs, pos = after_crc, hdr_end
+    elif codec == 1:                              # gzip
+        import gzip as _gzip
+
+        recs, pos = _gzip.decompress(after_crc[hdr_end:]), 0
+    else:
+        raise ValueError(f"unsupported record-batch codec {codec}")
     out: List[Tuple[int, Optional[bytes], Optional[bytes], int]] = []
     for _ in range(count):
-        _rec_len, pos = _read_varint(after_crc, pos)
+        _rec_len, pos = _read_varint(recs, pos)
         pos += 1                                  # record attributes
-        ts_delta, pos = _read_varint(after_crc, pos)
-        off_delta, pos = _read_varint(after_crc, pos)
+        ts_delta, pos = _read_varint(recs, pos)
+        off_delta, pos = _read_varint(recs, pos)
         blobs: List[Optional[bytes]] = []
         for _f in range(2):
-            n, pos = _read_varint(after_crc, pos)
+            n, pos = _read_varint(recs, pos)
             if n < 0:
                 blobs.append(None)
             else:
-                blobs.append(after_crc[pos:pos + n])
+                blobs.append(recs[pos:pos + n])
                 pos += n
-        n_headers, pos = _read_varint(after_crc, pos)
+        n_headers, pos = _read_varint(recs, pos)
         for _h in range(n_headers):
             for _kv in range(2):
-                n, pos = _read_varint(after_crc, pos)
+                n, pos = _read_varint(recs, pos)
                 pos += max(0, n)
         out.append((base_offset + off_delta, blobs[0], blobs[1],
                     first_ts + ts_delta))
@@ -448,10 +507,20 @@ class KafkaBroker:
 
     def __init__(self, bootstrap: str = "127.0.0.1:9092",
                  client_id: str = "rtfd-tpu", acks: int = -1,
-                 timeout_s: float = 30.0, idempotent: bool = False):
+                 timeout_s: float = 30.0, idempotent: bool = False,
+                 compression: Optional[str] = None):
         host, _, port = bootstrap.partition(":")
         self.acks = acks                         # -1 == acks=all (reference)
         self.timeout_s = timeout_s
+        # producer-side codec (reference compression.type=lz4,
+        # producer.properties:11; we speak gzip — see encode_record_batch).
+        # Applied on the RecordBatch v2 path, i.e. requires idempotent=True.
+        if compression is not None and not idempotent:
+            raise ValueError(
+                "compression requires the RecordBatch v2 producer "
+                "(idempotent=True); the legacy v1 message-set path stays "
+                "uncompressed")
+        self.compression = compression
         self._conn = KafkaConnection(host, int(port or 9092), client_id,
                                      timeout_s)
         self._coord: Optional[KafkaConnection] = None
@@ -583,7 +652,7 @@ class KafkaBroker:
                 seq = self._seq.get(key, 0)
             record_set = encode_record_batch(
                 messages, producer_id=pid, producer_epoch=pepoch,
-                base_sequence=seq)
+                base_sequence=seq, compression=self.compression)
             # Retry the SAME bytes (same baseSequence) across connection
             # failures: the broker recognizes a replayed sequence and
             # returns the original offset instead of double-appending —

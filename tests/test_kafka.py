@@ -1,6 +1,7 @@
 """Kafka transport tests: wire codec units + the transport contract suite
 over real sockets against the in-process protocol fake."""
 
+import json
 import struct
 import threading
 import time
@@ -184,6 +185,92 @@ def test_record_batch_v2_layout_and_round_trip():
     decoded, dpid, depoch, dseq = decode_record_batch(buf)
     assert (dpid, depoch, dseq) == (9, 2, 17)
     assert [(k, v, ts) for _o, k, v, ts in decoded] == msgs
+
+
+def test_record_batch_gzip_round_trip():
+    """Codec bit 1 (gzip) — the v2 analog of the reference's
+    compression.type producer setting (producer.properties:11)."""
+    import gzip
+
+    from realtime_fraud_detection_tpu.stream.kafka import (
+        crc32c,
+        decode_record_batch,
+        encode_record_batch,
+    )
+
+    msgs = [(b"k", json.dumps({"i": i, "pad": "x" * 200}).encode(), 1000 + i)
+            for i in range(50)]
+    plain = encode_record_batch(msgs, producer_id=3, producer_epoch=1,
+                                base_sequence=5)
+    packed = encode_record_batch(msgs, producer_id=3, producer_epoch=1,
+                                 base_sequence=5, compression="gzip")
+    # attributes codec bits say gzip; the wire form is genuinely smaller
+    attrs = struct.unpack_from(">h", packed, 21)[0]
+    assert attrs & 0x07 == 1
+    assert len(packed) < len(plain) // 2
+    # CRC covers the COMPRESSED form
+    assert struct.unpack_from(">I", packed, 17)[0] == crc32c(packed[21:])
+    decoded, pid, epoch, seq = decode_record_batch(packed)
+    assert (pid, epoch, seq) == (3, 1, 5)
+    assert [(k, v, ts) for _o, k, v, ts in decoded] == msgs
+
+    with pytest.raises(ValueError, match="unsupported compression"):
+        encode_record_batch(msgs, compression="lz4")
+
+
+def test_kafka_gzip_producer_end_to_end():
+    """Compressed idempotent produce through the wire client against the
+    protocol fake; the consumer transparently decompresses."""
+    server = FakeKafkaServer(port=0).start()
+    broker = KafkaBroker(bootstrap=f"127.0.0.1:{server.port}",
+                         idempotent=True, compression="gzip")
+    try:
+        for i in range(30):
+            broker.produce(T.TRANSACTIONS, {"n": i, "pad": "y" * 100},
+                           key="user_1")
+        recs = broker.consumer([T.TRANSACTIONS], "gz").poll(100)
+        assert [r.value["n"] for r in recs] == list(range(30))
+    finally:
+        broker.close()
+        server.stop()
+
+    with pytest.raises(ValueError, match="compression requires"):
+        KafkaBroker(bootstrap="127.0.0.1:1", compression="gzip")
+
+
+def test_fetch_decode_gzip_wrapper_and_raw_v2():
+    """What a REAL broker can hand a Fetch v2 consumer (the protocol fake
+    re-serves uncompressed v1, so these forms are constructed by hand):
+    a gzip wrapper message whose value is the inner message set, and a raw
+    RecordBatch v2 the broker chose not to down-convert."""
+    import gzip
+    import zlib as _zlib
+
+    from realtime_fraud_detection_tpu.stream.kafka import (
+        Writer,
+        decode_message_set,
+        encode_message_set,
+        encode_record_batch,
+    )
+
+    msgs = [(b"k0", b"v0", 10), (b"k1", b"v1", 11), (b"k2", b"v2", 12)]
+
+    # --- gzip v1 wrapper: value = gzip(inner message set), wrapper offset
+    # is the LAST inner message's absolute offset (v1 down-convert rule)
+    inner = encode_message_set(msgs)
+    body = (Writer().i8(1).i8(1)                  # magic=1, codec=gzip
+            .i64(99).bytes_(None).bytes_(gzip.compress(inner)).done())
+    crc = _zlib.crc32(body) & 0xFFFFFFFF
+    wrapper_msg = Writer().u32(crc).raw(body).done()
+    wire = Writer().i64(42).i32(len(wrapper_msg)).raw(wrapper_msg).done()
+    decoded = decode_message_set(wire)
+    assert [(k, v, ts) for _o, k, v, ts in decoded] == msgs
+    assert [o for o, *_ in decoded] == [40, 41, 42]   # rebased to wrapper
+
+    # --- raw RecordBatch v2 passthrough (no down-conversion)
+    batch = encode_record_batch(msgs, compression="gzip")
+    decoded2 = decode_message_set(batch)
+    assert [(k, v, ts) for _o, k, v, ts in decoded2] == msgs
 
 
 def test_record_batch_bad_crc_raises():
