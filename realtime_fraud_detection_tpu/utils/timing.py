@@ -1,0 +1,52 @@
+"""Shared device-timing discipline for bench.py and tune_tpu.py.
+
+Two rules, both learned the hard way on the tunneled TPU (round 4):
+
+1. **Vary the input every timed call.** The relay serves a repeated
+   identical computation from a result cache — the r3-era bench measured a
+   physically impossible 1.1 ms blocked call this way. Timed callables
+   take the iteration index so callers cycle pre-staged input variants.
+
+2. **Never pull device->host before or between timed sections.** The first
+   ``device_get``/``np.asarray`` on a device array permanently switches
+   the tunnel into synchronous dispatch (~85 ms per call); only
+   ``block_until_ready`` is safe inside timed code. Build input variants
+   from HOST arrays and ``device_put`` them; defer all result pulls past
+   the last timed section.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, List
+
+
+def time_blocked(fn: Callable[[int], object], iters: int) -> List[float]:
+    """Per-call latency in seconds: block on each call before the next.
+
+    ``fn(i)`` must produce a fresh computation per index (rule 1).
+    """
+    import jax
+
+    jax.block_until_ready(fn(0))         # warm (compile already done)
+    times = []
+    for i in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(i + 1))
+        times.append(time.perf_counter() - t0)
+    return times
+
+
+def throughput_pipelined(fn: Callable[[int], object], batch_size: int,
+                         iters: int) -> float:
+    """Items/second with async dispatch: the device stays fed, one block at
+    the end. This is the number a local (non-tunneled) host observes, and
+    the basis for honest MFU — no cache or dispatch artifact can inflate
+    it. ``fn(i)`` varies per call (rule 1)."""
+    import jax
+
+    jax.block_until_ready(fn(0))
+    t0 = time.perf_counter()
+    outs = [fn(i + 1) for i in range(iters)]
+    jax.block_until_ready(outs)
+    return batch_size * iters / (time.perf_counter() - t0)

@@ -42,17 +42,21 @@ def _emit(**kv) -> None:
 
 
 def _time_blocked(fn, iters: int) -> dict:
-    import jax
+    """Shared discipline (utils/timing.py): varied inputs, no d2h pulls."""
+    from realtime_fraud_detection_tpu.utils.timing import time_blocked
 
-    jax.block_until_ready(fn())
-    times = []
-    for _ in range(iters):
-        t0 = time.perf_counter()
-        jax.block_until_ready(fn())
-        times.append(time.perf_counter() - t0)
-    ms = np.asarray(times) * 1e3
+    ms = np.asarray(time_blocked(fn, iters)) * 1e3
     return {"p50_ms": round(float(np.percentile(ms, 50)), 3),
             "p99_ms": round(float(np.percentile(ms, 99)), 3)}
+
+
+def _throughput(fn, batch: int, iters: int) -> float:
+    """Shared discipline (utils/timing.py): varied inputs, no d2h pulls."""
+    from realtime_fraud_detection_tpu.utils.timing import (
+        throughput_pipelined,
+    )
+
+    return throughput_pipelined(fn, batch, iters)
 
 
 def main() -> int:
@@ -87,11 +91,13 @@ def main() -> int:
     # 1 ------------------------------------------------- pallas block sweep
     for seq in (64, 128, 512):
         b, h, d = 64, 12, 64
-        q, k, v = (jnp.asarray(rng.standard_normal((b, h, seq, d)),
-                               jnp.float32) for _ in range(3))
+        k, v = (jnp.asarray(rng.standard_normal((b, h, seq, d)),
+                            jnp.float32) for _ in range(2))
+        qs = [jnp.asarray(rng.standard_normal((b, h, seq, d)), jnp.float32)
+              for _ in range(8)]
         mask = jnp.ones((b, seq), bool)
         ref = jax.jit(lambda q, k, v, m: attention_reference(q, k, v, m))
-        base = _time_blocked(lambda: ref(q, k, v, mask), 30)
+        base = _time_blocked(lambda i: ref(qs[i % 8], k, v, mask), 30)
         _emit(stage="attn", seq=seq, impl="xla", **base)
         for bq in (64, 128, 256):
             for bk in (64, 128, 256):
@@ -99,8 +105,8 @@ def main() -> int:
                     continue
                 try:
                     t = _time_blocked(
-                        lambda: flash_attention(q, k, v, mask,
-                                                block_q=bq, block_k=bk), 30)
+                        lambda i: flash_attention(qs[i % 8], k, v, mask,
+                                                  block_q=bq, block_k=bk), 30)
                 except Exception as e:  # noqa: BLE001
                     _emit(stage="attn", seq=seq, impl="pallas", block_q=bq,
                           block_k=bk, error=str(e)[:120])
@@ -119,11 +125,21 @@ def main() -> int:
     fused = jax.jit(lambda m, b, p, v: score_fused(
         m, b, p, v, bert_config=bert_config, with_model_preds=False))
     for bucket in (64, 128, 256, 512, 1024):
-        batch = jax.device_put(make_example_batch(
-            bucket, sc, rng=np.random.default_rng(bucket)))
-        t = _time_blocked(lambda: fused(models, batch, params, valid), 40)
-        _emit(stage="bucket", bucket=bucket,
-              txn_per_s=round(bucket / (t["p50_ms"] / 1e3), 1), **t)
+        host_batch = make_example_batch(
+            bucket, sc, rng=np.random.default_rng(bucket))
+        # variants built from the HOST copy (a np.asarray on the device
+        # copy would be a d2h pull — the tunnel sync-mode trap)
+        feats = [jax.device_put(host_batch.features + np.float32(j))
+                 for j in range(8)]
+        batch = jax.device_put(host_batch)
+        t = _time_blocked(
+            lambda i: fused(models, batch.replace(features=feats[i % 8]),
+                            params, valid), 40)
+        tput = _throughput(
+            lambda i: fused(models, batch.replace(features=feats[i % 8]),
+                            params, valid), bucket, 40)
+        _emit(stage="bucket", bucket=bucket, txn_per_s=round(tput, 1),
+              ms_per_batch_pipelined=round(1e3 * bucket / tput, 3), **t)
 
     # 3 ------------------------------------------------ per-branch split
     from realtime_fraud_detection_tpu.models.isolation_forest import (
@@ -132,20 +148,32 @@ def main() -> int:
     from realtime_fraud_detection_tpu.models.lstm import lstm_logits
     from realtime_fraud_detection_tpu.models.trees import tree_ensemble_predict
 
-    batch = jax.device_put(make_example_batch(
-        256, sc, rng=np.random.default_rng(1)))
+    host_batch = make_example_batch(256, sc, rng=np.random.default_rng(1))
+    feats = [jax.device_put(host_batch.features + np.float32(j))
+             for j in range(8)]
+    hists = [jax.device_put(host_batch.history + np.float32(j))
+             for j in range(8)]
+    toks = [jax.device_put(((host_batch.token_ids + j)
+                            % bert_config.vocab_size).astype(np.int32))
+            for j in range(8)]
+    batch = jax.device_put(host_batch)
+    jtree = jax.jit(lambda f: tree_ensemble_predict(models.trees, f))
+    jifo = jax.jit(lambda f: iforest_predict(models.iforest, f))
+    jlstm = jax.jit(lambda h: jax.nn.sigmoid(lstm_logits(
+        models.lstm, h, batch.history_len)))
+    jbert = jax.jit(lambda t: bert_predict(
+        models.bert, t, batch.token_mask, bert_config))
     branches = {
-        "trees": jax.jit(lambda: tree_ensemble_predict(
-            models.trees, batch.features)),
-        "iforest": jax.jit(lambda: iforest_predict(
-            models.iforest, batch.features)),
-        "lstm": jax.jit(lambda: jax.nn.sigmoid(lstm_logits(
-            models.lstm, batch.history, batch.history_len))),
-        "bert": jax.jit(lambda: bert_predict(
-            models.bert, batch.token_ids, batch.token_mask, bert_config)),
+        "trees": (lambda i: jtree(feats[i % 8])),
+        "iforest": (lambda i: jifo(feats[i % 8])),
+        "lstm": (lambda i: jlstm(hists[i % 8])),
+        "bert": (lambda i: jbert(toks[i % 8])),
     }
     for name, fn in branches.items():
-        _emit(stage="branch", branch=name, batch=256, **_time_blocked(fn, 30))
+        t = _time_blocked(fn, 30)
+        tput = _throughput(fn, 256, 30)
+        _emit(stage="branch", branch=name, batch=256,
+              ms_per_batch_pipelined=round(256e3 / tput, 3), **t)
     return 0
 
 
