@@ -458,6 +458,10 @@ class FraudScorer:
             merged = dict(rec)
             merged["fraud_score"] = res["fraud_score"]
             merged["decision"] = res["decision"]
+            # enough for the dedupe path to re-emit a faithful prediction
+            # from cache (stream/job.py _emit_cached_dups)
+            merged["risk_level"] = res["risk_level"]
+            merged["confidence"] = res["confidence"]
             self.txn_cache.cache_transaction(merged, now=ts)
 
     # ------------------------------------------------------------------ info

@@ -80,6 +80,13 @@ class _BatchCtx:
     # records rejected by per-record ingest sanitization; each gets its own
     # error result at completion — they never poison the rest of the batch
     invalid: List[tuple] = dataclasses.field(default_factory=list)
+    # txn-cache duplicates: (record, cached result) pairs. State write-back
+    # happens BEFORE fan-out (finalize order), so a crash between the two
+    # leaves a record cached but its prediction never produced; on replay
+    # the dedupe path re-emits the prediction from the cache instead of
+    # silently swallowing it. Predictions are thereby at-least-once while
+    # scoring + state stay effectively-once (consumers dedupe by txn id).
+    cached_dups: List[tuple] = dataclasses.field(default_factory=list)
 
 
 class StreamJob:
@@ -141,6 +148,7 @@ class StreamJob:
             return None
         fresh: List[Record] = []
         invalid: List[tuple] = []
+        cached_dups: List[tuple] = []
         batch_ids: set = set()
         for r in records:
             txn, errors = sanitize_for_stream(r.value)
@@ -151,17 +159,29 @@ class StreamJob:
                 invalid.append((r, errors))
                 continue
             txn_id = txn["transaction_id"]  # sanitizer guarantees non-empty
-            if (txn_id in batch_ids  # duplicate within this very batch
-                    or txn_id in self._inflight_ids  # in a dispatched batch
-                    or self.scorer.txn_cache.get_transaction(txn_id, now=now)
-                    is not None):
-                self.counters["duplicates_skipped"] += 1  # replay/dup dedupe
+            if txn_id in batch_ids or txn_id in self._inflight_ids:
+                # first instance (this batch / a dispatched batch) will
+                # emit the prediction itself — skip silently
+                self.counters["duplicates_skipped"] += 1
+                continue
+            cached = self.scorer.txn_cache.get_transaction(txn_id, now=now)
+            if cached is not None:
+                # already scored + written back. Its prediction may never
+                # have been produced (crash between write-back and
+                # fan-out), so re-emit from the cache at completion —
+                # at-least-once predictions, no re-scoring, no
+                # double-counted velocity. batch_ids gets the id so a
+                # second copy in this same poll re-emits only once.
+                self.counters["duplicates_skipped"] += 1
+                batch_ids.add(txn_id)
+                cached_dups.append((r, cached))
                 continue
             batch_ids.add(txn_id)
             fresh.append(dataclasses.replace(r, value=txn))
         positions = self.consumer.snapshot_positions()
         if not fresh:
-            return _BatchCtx([], set(), None, positions, now, invalid)
+            return _BatchCtx([], set(), None, positions, now, invalid,
+                             cached_dups)
         pending = None
         try:
             pending = self.scorer.dispatch([r.value for r in fresh], now=now)
@@ -170,7 +190,8 @@ class StreamJob:
             # stream alive; counted at completion
             pass
         self._inflight_ids |= batch_ids
-        return _BatchCtx(fresh, batch_ids, pending, positions, now, invalid)
+        return _BatchCtx(fresh, batch_ids, pending, positions, now, invalid,
+                         cached_dups)
 
     def complete_batch(self, ctx: "_BatchCtx") -> List[Dict[str, Any]]:
         """Stage 2: block on the device result, fan out, commit offsets."""
@@ -178,6 +199,7 @@ class StreamJob:
         fresh, now = ctx.fresh, ctx.now
         if not fresh:
             invalid_results = self._emit_invalid(ctx)  # no ids at risk
+            self._emit_cached_dups(ctx)
             self.consumer.commit(ctx.positions)
             return invalid_results
 
@@ -210,6 +232,7 @@ class StreamJob:
             # inside the protective try: a produce failure here must release
             # the in-flight ids like any other fan-out failure
             invalid_results = self._emit_invalid(ctx)
+            self._emit_cached_dups(ctx)
             return invalid_results + self._fan_out(
                 ctx, fresh, results, feats, scored_ok, now)
         finally:
@@ -244,6 +267,31 @@ class StreamJob:
                                 key=str(value.get("user_id", "")))
             results.append(res)
         return results
+
+    def _emit_cached_dups(self, ctx: "_BatchCtx") -> None:
+        """Re-emit predictions for txn-cache duplicates from their cached
+        results. A record lands here only if it was scored AND written back
+        previously; whether its prediction was actually produced before a
+        crash is unknowable, so re-emitting is the at-least-once answer —
+        downstream consumers dedupe by transaction_id."""
+        for rec, cached in ctx.cached_dups:
+            value = rec.value if isinstance(rec.value, dict) else {}
+            self.broker.produce(
+                T.PREDICTIONS,
+                {
+                    "transaction_id": str(cached.get("transaction_id") or
+                                          value.get("transaction_id", "")),
+                    "fraud_probability": float(cached.get("fraud_score", 0.5)),
+                    "fraud_score": float(cached.get("fraud_score", 0.5)),
+                    "risk_level": str(cached.get("risk_level", "UNKNOWN")),
+                    "decision": str(cached.get("decision", "REVIEW")),
+                    "model_predictions": {},
+                    "confidence": float(cached.get("confidence", 0.0)),
+                    "processing_time_ms": 0.0,
+                    "explanation": {"replayed_from_cache": True},
+                },
+                key=str(value.get("user_id", "")),
+            )
 
     def _fan_out(self, ctx: "_BatchCtx", fresh: List[Record],
                  results: List[Dict[str, Any]], feats, scored_ok: bool,

@@ -192,6 +192,19 @@ def test_stream_job_replay_dedupe(job_env):
     job.run_until_drained(now=2001.0)
     assert job.counters["scored"] == before
     assert job.counters["duplicates_skipped"] == 10
+    # cache-hit duplicates re-emit their prediction ONCE each (at-least-
+    # once delivery), even when redelivery lands both copies in one poll
+    broker.produce_batch(T.TRANSACTIONS, records + records,
+                         key_fn=lambda r: str(r["user_id"]))
+    job.run_until_drained(now=2002.0)
+    assert job.counters["scored"] == before
+    preds = broker.consumer([T.PREDICTIONS], "rchk").poll(1000)
+    from collections import Counter
+    replayed = Counter(p.value["transaction_id"] for p in preds
+                       if p.value["explanation"].get("replayed_from_cache"))
+    # run 2 re-emitted each id once; run 3's double-copy collapsed to one
+    assert set(replayed.values()) == {2}
+    assert len(replayed) == 10
 
 
 def test_enrichment_applies_with_analytics_only(job_env):
@@ -262,6 +275,74 @@ def test_pipelined_commit_covers_only_dispatched_offsets():
     # only batch1's records are covered by the commit: batch2 replays
     lag = broker.lag(job.config.group_id, T.TRANSACTIONS)
     assert lag == len(batch2)
+
+
+def test_depth3_crash_between_writeback_and_fanout_loses_nothing():
+    """THE depth-3 failure drill: three batches in flight, the oldest
+    crashes BETWEEN state write-back (finalize succeeded — records are in
+    the txn cache) and fan-out (no prediction produced). The job dies
+    (contract: completion failure propagates; later in-flights are
+    abandoned). A restarted job must deliver a prediction for EVERY
+    record: the cached-but-never-produced ones re-emit from the cache (not
+    re-scored, velocity not double-counted), the rest re-score normally."""
+    gen = TransactionGenerator(num_users=40, num_merchants=10, seed=31)
+    broker = InMemoryBroker()
+    scorer = FraudScorer(scorer_config=ScorerConfig(text_len=32))
+    scorer.seed_profiles(gen.users.profiles(), gen.merchants.profiles())
+    job = StreamJob(broker, scorer,
+                    JobConfig(max_batch=8, pipeline_depth=3))
+    broker.produce_batch(T.TRANSACTIONS, gen.generate_batch(24),
+                         key_fn=lambda r: str(r["user_id"]))
+
+    ctxs = []
+    for i in range(3):
+        batch = job.assembler.next_batch(block=True, timeout_s=1.0)
+        assert batch
+        ctxs.append(job.dispatch_batch(batch, now=1000.0 + i))
+    n0 = len(ctxs[0].fresh)
+    assert n0 > 0
+    assert len(job._inflight_ids) == sum(len(c.fresh) for c in ctxs)
+
+    real_produce = broker.produce
+    broker.produce = lambda *a, **k: (_ for _ in ()).throw(OSError("down"))
+    with pytest.raises(OSError):
+        job.complete_batch(ctxs[0])   # finalize ran -> cache written;
+    broker.produce = real_produce     # fan-out failed -> nothing produced
+
+    assert len(job._inflight_ids) == sum(len(c.fresh) for c in ctxs[1:])
+    # job crashes here: ctxs[1]/ctxs[2] are abandoned, nothing committed
+
+    job2 = StreamJob(broker, scorer,
+                     JobConfig(max_batch=8, pipeline_depth=3))
+    rescored = job2.run_until_drained(now=1010.0)
+    # batch-1 records are cache hits (scored, state written): re-emitted
+    # from cache, not re-scored; everything else re-scores
+    assert rescored == 24 - n0
+    assert job2.counters["duplicates_skipped"] == n0
+    assert broker.lag(job2.config.group_id, T.TRANSACTIONS) == 0
+    preds = broker.consumer([T.PREDICTIONS], "chk").poll(1000)
+    ids = {p.value["transaction_id"] for p in preds}
+    assert len(preds) == 24 and len(ids) == 24   # every record delivered
+    replayed = [p for p in preds
+                if p.value["explanation"].get("replayed_from_cache")]
+    assert len(replayed) == n0
+
+
+def test_run_for_depth3_drains_and_scores_everything():
+    """run_for with depth 3 completes every dispatched batch by return."""
+    gen = TransactionGenerator(num_users=30, num_merchants=10, seed=37)
+    broker = InMemoryBroker()
+    scorer = FraudScorer(scorer_config=ScorerConfig(text_len=32))
+    scorer.seed_profiles(gen.users.profiles(), gen.merchants.profiles())
+    job = StreamJob(broker, scorer,
+                    JobConfig(max_batch=8, max_delay_ms=1.0,
+                              pipeline_depth=3))
+    broker.produce_batch(T.TRANSACTIONS, gen.generate_batch(40),
+                         key_fn=lambda r: str(r["user_id"]))
+    scored = job.run_for(3.0)
+    assert scored == 40
+    assert not job._inflight_ids
+    assert broker.lag(job.config.group_id, T.TRANSACTIONS) == 0
 
 
 def test_topic_contract_mirrors_reference():
