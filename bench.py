@@ -659,11 +659,12 @@ def run_bench() -> None:
         x_tr = np.concatenate(tr_feats)
         y_tr = np.concatenate(tr_labels)
         _log('e2e soak: fitting trees + isolation forest')
-        trees = GBDTTrainer(n_estimators=40, max_depth=5, seed=2).fit(
-            x_tr, y_tr)
+        gtr = GBDTTrainer(n_estimators=40, max_depth=5, seed=2)
+        trees = gtr.fit(x_tr, y_tr)
         iforest = IsolationForestTrainer(n_estimators=100, seed=4).fit(
             x_tr[y_tr < 0.5][:6000])
         scorer.set_models(models.replace(trees=trees, iforest=iforest))
+        scorer.set_feature_importances(gtr.feature_importances_)
         # Production blend: the untrained neural branches stay ENABLED on
         # device (they execute in the fused program — the throughput number
         # is the full 5-branch program) but are masked out of the score

@@ -270,8 +270,8 @@ def cmd_train(args: argparse.Namespace) -> int:
     y = labels["is_fraud"].astype(np.float32)
     split = int(0.8 * len(y))
 
-    trees = GBDTTrainer(n_estimators=args.trees,
-                        seed=args.seed).fit(x[:split], y[:split])
+    gbdt_trainer = GBDTTrainer(n_estimators=args.trees, seed=args.seed)
+    trees = gbdt_trainer.fit(x[:split], y[:split])
     from realtime_fraud_detection_tpu.models.trees import tree_ensemble_logits
 
     logits = np.asarray(tree_ensemble_logits(trees, x[split:]))
@@ -314,9 +314,15 @@ def cmd_train(args: argparse.Namespace) -> int:
     path = mgr.save(0, params=models,
                     metadata={"rows": args.rows, "auc": auc,
                               "fraud_rate": float(y.mean())})
+    from realtime_fraud_detection_tpu.features.extract import (
+        top_feature_importances,
+    )
+
     print(json.dumps({"auc": round(auc, 4),
                       "fraud_rate": round(float(y.mean()), 4),
                       "neural_trained": bool(args.neural),
+                      "top_feature_importances": top_feature_importances(
+                          gbdt_trainer.feature_importances_),
                       "checkpoint": str(path)}))
     return 0
 

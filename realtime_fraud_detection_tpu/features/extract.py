@@ -80,6 +80,26 @@ def _haversine_km(lat1, lon1, lat2, lon2):
     return 6371.0 * 2.0 * jnp.arctan2(jnp.sqrt(a), jnp.sqrt(1.0 - a))
 
 
+def top_feature_importances(importances, k: int = 10):
+    """Top-k {feature name: score} from a per-feature importance vector.
+
+    The reference surfaces this in prediction explanations
+    (ensemble_predictor.py:371-435). Length must match the 64-name
+    contract — a trainer fit on a different feature matrix must not get
+    its indices silently mislabeled with canonical names.
+    """
+    import numpy as np
+
+    arr = np.asarray(importances, np.float32)
+    if arr.shape != (len(FEATURE_NAMES),):
+        raise ValueError(
+            f"importances shape {arr.shape} != ({len(FEATURE_NAMES)},) — "
+            "not the canonical feature contract")
+    order = np.argsort(arr)[::-1][:k]
+    return {FEATURE_NAMES[i]: round(float(arr[i]), 6)
+            for i in order if arr[i] > 0}
+
+
 def extract_features_host(b: TransactionBatch):
     """``extract_features`` pinned to the host CPU backend. Returns f32[B, 64]
     as a NumPy array.

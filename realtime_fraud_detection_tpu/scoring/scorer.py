@@ -213,6 +213,10 @@ class FraudScorer:
         self._merchants = _EntityIndex(self.sc.node_dim)
         self.last_features = np.zeros((0, self.sc.feature_dim), np.float32)
         self.stats: Dict[str, float] = {"scored": 0, "batches": 0, "total_time_s": 0.0}
+        # top-10 global feature importances (reference explanation field,
+        # ensemble_predictor.py:371-435); set after training via
+        # set_feature_importances, attached to every explanation
+        self._top_importances: Optional[Dict[str, float]] = None
 
     # ------------------------------------------------------------- state plane
     def seed_profiles(self, users: Mapping[str, Mapping[str, Any]],
@@ -229,13 +233,32 @@ class FraudScorer:
         return self._mv_cache[1]
 
     # ----------------------------------------------------------------- models
+    def set_feature_importances(self, importances) -> None:
+        """Attach global gain importances (e.g. ``GBDTTrainer.
+        feature_importances_``) to prediction explanations as the top-10
+        name->score mapping the reference emits (§2.2). Pass None to clear."""
+        if importances is None:
+            self._top_importances = None
+            return
+        from realtime_fraud_detection_tpu.features.extract import (
+            top_feature_importances,
+        )
+
+        self._top_importances = top_feature_importances(importances)
+
     def set_models(self, models: ScoringModels) -> None:
         """Swap the model set (hot reload). Params are replicated onto this
         scorer's mesh — arrays restored from checkpoint arrive committed to
-        one device, which would clash with mesh-sharded batch arguments."""
+        one device, which would clash with mesh-sharded batch arguments.
+
+        Clears any attached feature importances: they describe the OLD
+        trees; the caller re-attaches via set_feature_importances if it has
+        importances for the new model set.
+        """
         from realtime_fraud_detection_tpu.core.mesh import replicated_sharding
 
         self.models = jax.device_put(models, replicated_sharding(self.mesh))
+        self._top_importances = None
 
     # ---------------------------------------------------------------- assembly
     def assemble(self, records: Sequence[Mapping[str, Any]],
@@ -432,6 +455,16 @@ class FraudScorer:
                 name: float(weights[j] * preds[i, j])
                 for j, name in enumerate(MODEL_NAMES) if self.model_valid[j]
             }
+            explanation = {
+                "model_contributions": contributions,
+                "key_factors": factors,
+                "rule_score": float(rule[i]),
+            }
+            if self._top_importances is not None:
+                # fresh dict per response: a consumer mutating one
+                # explanation must not corrupt its batch-mates
+                explanation["top_feature_importances"] = dict(
+                    self._top_importances)
             results.append({
                 "transaction_id": str(rec.get("transaction_id", "")),
                 "fraud_probability": float(probs[i]),
@@ -441,11 +474,7 @@ class FraudScorer:
                 "model_predictions": model_predictions,
                 "confidence": float(conf[i]),
                 "processing_time_ms": per_txn_ms,
-                "explanation": {
-                    "model_contributions": contributions,
-                    "key_factors": factors,
-                    "rule_score": float(rule[i]),
-                },
+                "explanation": explanation,
             })
         return results
 

@@ -36,7 +36,13 @@ class GBDTTrainer:
     seed: int = 42
 
     def fit(self, x: np.ndarray, y: np.ndarray) -> TreeEnsemble:
-        """Fit on (N, F) features and {0,1} labels; returns device-ready trees."""
+        """Fit on (N, F) features and {0,1} labels; returns device-ready trees.
+
+        Also sets ``self.feature_importances_`` — per-feature total split
+        gain, normalized to sum 1 (the xgboost "gain" importance the
+        reference surfaces as top-10 feature importances in its prediction
+        explanations, ensemble_predictor.py:371-435).
+        """
         rng = np.random.default_rng(self.seed)
         x = np.asarray(x, np.float32)
         y = np.asarray(y, np.float32)
@@ -59,6 +65,7 @@ class GBDTTrainer:
         feat_arr = np.zeros((self.n_estimators, n_internal), np.int32)
         thr_arr = np.full((self.n_estimators, n_internal), np.inf, np.float32)
         leaf_arr = np.zeros((self.n_estimators, n_leaf), np.float32)
+        gain_by_feature = np.zeros(f, np.float64)
 
         for t in range(self.n_estimators):
             p = 1.0 / (1.0 + np.exp(-logits))
@@ -80,8 +87,9 @@ class GBDTTrainer:
                     # leaf early: park samples in leftmost descendant leaf
                     node_of[mask] = _leftmost_leaf(node, depth)
                     continue
-                ci, bin_id, _ = split
+                ci, bin_id, gain = split
                 j = cols[ci]
+                gain_by_feature[j] += gain
                 feat_arr[t, node] = j
                 thr_arr[t, node] = (
                     edges[bin_id, j] if bin_id < edges.shape[0] else np.float32(np.inf)
@@ -105,6 +113,12 @@ class GBDTTrainer:
             logits += _numpy_tree_forward(
                 feat_arr[t], thr_arr[t], leaf_arr[t], x
             )
+
+        total_gain = gain_by_feature.sum()
+        self.feature_importances_ = (
+            (gain_by_feature / total_gain).astype(np.float32)
+            if total_gain > 0 else np.zeros(f, np.float32)
+        )
 
         import jax.numpy as jnp
 

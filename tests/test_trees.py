@@ -94,3 +94,49 @@ class TestTrainer:
         ens = GBDTTrainer(n_estimators=12, max_depth=6).fit(x, y)
         assert ens.feature.shape == (12, 63)
         assert ens.leaf.shape == (12, 64)
+
+
+class TestFeatureImportances:
+    def test_gain_importances_find_the_signal_features(self):
+        """The toy rule uses features 0,1,2 only — gain importance must
+        concentrate there (the reference's top-10 explanation field,
+        ensemble_predictor.py:371-435)."""
+        x, y = _toy_problem(n=3000)
+        tr = GBDTTrainer(n_estimators=20, max_depth=4, seed=1)
+        tr.fit(x, y)
+        imp = tr.feature_importances_
+        assert imp.shape == (16,)
+        assert abs(float(imp.sum()) - 1.0) < 1e-5
+        assert (imp >= 0).all()
+        assert set(np.argsort(imp)[::-1][:3]) == {0, 1, 2}
+
+    def test_importance_length_must_match_feature_contract(self):
+        from realtime_fraud_detection_tpu.features.extract import (
+            top_feature_importances,
+        )
+
+        with pytest.raises(ValueError, match="feature contract"):
+            top_feature_importances(np.ones(16, np.float32))
+
+    def test_scorer_attaches_top10_to_explanations(self):
+        from realtime_fraud_detection_tpu.features.extract import FEATURE_NAMES
+        from realtime_fraud_detection_tpu.scoring import FraudScorer
+        from realtime_fraud_detection_tpu.sim.simulator import TransactionGenerator
+
+        gen = TransactionGenerator(num_users=32, num_merchants=8, seed=3)
+        scorer = FraudScorer(seed=0)
+        scorer.seed_profiles(gen.users.profiles(), gen.merchants.profiles())
+        recs = gen.generate_batch(4)
+        assert "top_feature_importances" not in (
+            scorer.score_batch(recs)[0]["explanation"])
+
+        imp = np.zeros(64, np.float32)
+        imp[5], imp[0], imp[63] = 0.5, 0.3, 0.2
+        scorer.set_feature_importances(imp)
+        out = scorer.score_batch(gen.generate_batch(4))[0]
+        top = out["explanation"]["top_feature_importances"]
+        assert list(top) == [FEATURE_NAMES[5], FEATURE_NAMES[0],
+                             FEATURE_NAMES[63]]
+        scorer.set_feature_importances(None)
+        assert "top_feature_importances" not in (
+            scorer.score_batch(gen.generate_batch(4))[0]["explanation"])
