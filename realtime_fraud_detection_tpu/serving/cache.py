@@ -1,0 +1,64 @@
+"""Serving-side prediction cache: TTL + bounded size, evict-oldest.
+
+Mirror of the reference's ensemble prediction cache
+(ensemble_predictor.py:437-471 — 300 s TTL, max 1000 entries, LRU-by-oldest),
+keyed by transaction_id: a retried /predict or /batch-predict for the same
+transaction serves the stored §2.7 response without another device round
+trip. Scoring is stateful (velocity/history move on), so the cache exists
+for idempotent retries, not memoization — the TTL bounds how stale a
+served-again response can be.
+
+Single-writer like the rest of the serving host state: callers hold the
+serving score lock.
+"""
+
+from __future__ import annotations
+
+import copy
+import time
+from collections import OrderedDict
+from typing import Any, Dict, Optional
+
+
+class PredictionCache:
+    def __init__(self, ttl_seconds: float = 300.0, max_entries: int = 1000):
+        self.ttl = ttl_seconds
+        self.max_entries = max_entries
+        self._data: "OrderedDict[str, tuple[float, Dict[str, Any]]]" = (
+            OrderedDict())
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, key: str, now: Optional[float] = None) -> Optional[Dict[str, Any]]:
+        """Deep copy out: a caller mutating the served response (experiment
+        annotation, downstream enrichment) must not corrupt the entry."""
+        now = now if now is not None else time.monotonic()
+        entry = self._data.get(key)
+        if entry is None or now - entry[0] > self.ttl:
+            if entry is not None:
+                del self._data[key]    # expired
+            self.misses += 1
+            return None
+        self.hits += 1
+        return copy.deepcopy(entry[1])
+
+    def put(self, key: str, result: Dict[str, Any],
+            now: Optional[float] = None) -> None:
+        """Deep copy in: the stored response is frozen at serve time."""
+        if not key:
+            return
+        now = now if now is not None else time.monotonic()
+        self._data[key] = (now, copy.deepcopy(result))
+        self._data.move_to_end(key)
+        while len(self._data) > self.max_entries:
+            self._data.popitem(last=False)         # evict oldest insertion
+
+    def clear(self) -> None:
+        """Drop entries, keep hit/miss counters (they are monotonic counters
+        on /health — a model reload must not reset a scraped series)."""
+        self._data.clear()
+
+    def stats(self) -> Dict[str, Any]:
+        return {"entries": len(self._data), "hits": self.hits,
+                "misses": self.misses, "ttl_seconds": self.ttl,
+                "max_entries": self.max_entries}

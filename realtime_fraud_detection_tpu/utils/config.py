@@ -82,6 +82,13 @@ class ServingConfig:
     # Microbatcher: fixed-latency deadline + max batch
     microbatch_deadline_ms: float = 5.0
     microbatch_max_size: int = 256
+    # Prediction TTL cache (reference ensemble_predictor.py:437-471:
+    # 300 s TTL, max 1000 entries, evict-oldest), keyed by transaction_id —
+    # idempotent retries of the same transaction serve the cached §2.7
+    # response without re-scoring
+    enable_prediction_cache: bool = True
+    prediction_cache_ttl_seconds: float = 300.0
+    prediction_cache_max_entries: int = 1000
 
 
 @dataclass

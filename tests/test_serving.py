@@ -214,6 +214,36 @@ class TestEndpoints:
             "xgboost_primary", "lstm_sequential", "bert_text",
             "graph_neural", "isolation_forest"}
 
+    def test_prediction_cache_serves_idempotent_retry(self, app_server):
+        """Reference TTL prediction cache (ensemble_predictor.py:437-471):
+        a retried transaction_id serves the stored response without
+        re-scoring; /health exposes the cache stats."""
+        app, gen = app_server
+        txn = _txn(gen)
+        _, first = _request(app.port, "POST", "/predict", txn)
+        hits_before = app.prediction_cache.hits
+        _, retry = _request(app.port, "POST", "/predict", txn)
+        assert app.prediction_cache.hits == hits_before + 1
+        assert retry["fraud_probability"] == first["fraud_probability"]
+        assert retry["transaction_id"] == first["transaction_id"]
+        _, health = _request(app.port, "GET", "/health")
+        assert health["prediction_cache"]["hits"] >= 1
+
+    def test_prediction_cache_unit_ttl_and_eviction(self):
+        from realtime_fraud_detection_tpu.serving.cache import PredictionCache
+
+        c = PredictionCache(ttl_seconds=10.0, max_entries=3)
+        for i in range(5):
+            c.put(f"t{i}", {"i": i}, now=float(i))
+        # oldest two evicted by the size bound
+        assert c.get("t0", now=5.0) is None
+        assert c.get("t1", now=5.0) is None
+        assert c.get("t4", now=5.0) == {"i": 4}
+        # TTL expiry: inserted at t=4, TTL 10 -> gone just past t=14
+        assert c.get("t4", now=13.9) == {"i": 4}
+        assert c.get("t4", now=14.1) is None
+        assert c.stats()["max_entries"] == 3
+
     def test_predict_validation_422(self, app_server):
         app, _ = app_server
         status, data = _request(app.port, "POST", "/predict",
