@@ -309,11 +309,18 @@ def cmd_train(args: argparse.Namespace) -> int:
         models = models.replace(lstm=lstm, gnn=gnn, bert=bert)
 
     mgr = CheckpointManager(args.out)
-    # model_shapes (restore-compatibility dims) is auto-derived by save()
-    # into the manifest; metadata stays purely user-facing.
-    path = mgr.save(0, params=models,
+    # a FRESH step per run (never overwrite in place): a reader — the
+    # serving hot-reload or the 3 AM validate CronJob — resolving "latest"
+    # mid-save sees the previous complete step, not a torn rmtree'd dir.
+    # The recorded sim_seed lets validate refuse a contaminated eval stream.
+    latest = mgr.latest_step()
+    step = 0 if latest is None else latest + 1
+    path = mgr.save(step, params=models,
                     metadata={"rows": args.rows, "auc": auc,
-                              "fraud_rate": float(y.mean())})
+                              "fraud_rate": float(y.mean()),
+                              "sim_seed": args.seed,
+                              "sim_users": args.users,
+                              "sim_merchants": args.merchants})
     from realtime_fraud_detection_tpu.features.extract import (
         top_feature_importances,
     )
@@ -348,6 +355,84 @@ def _auc(y: "Any", score: "Any") -> float:
     if not n_pos or not n_neg:
         return 0.5
     return float((rank[pos].sum() - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg))
+
+
+def cmd_validate(args: argparse.Namespace) -> int:
+    """Validate a trained checkpoint against a fresh labeled stream.
+
+    The reference schedules this as its model-validation CronJob
+    (ci-cd-pipeline.yaml:351-390: daily run, metrics pushed to a Prometheus
+    gateway) but ships no implementation. Here: restore the checkpoint into
+    a scorer, score a freshly simulated stream with known injected fraud,
+    report AUC/accuracy/precision/recall, optionally write a Prometheus
+    textfile, and FAIL (exit 1) below --min-auc so the CronJob's status is
+    the quality gate.
+    """
+    import numpy as np
+
+    from realtime_fraud_detection_tpu.checkpoint import CheckpointManager
+    from realtime_fraud_detection_tpu.scoring import FraudScorer
+    from realtime_fraud_detection_tpu.sim.simulator import TransactionGenerator
+
+    scorer = FraudScorer()
+    ckpt = CheckpointManager(args.checkpoint_dir).restore_into_scorer(
+        scorer, step=args.step)
+    # Held-out eval stream: never the checkpoint's recorded training seed.
+    # The +1 convention alone is not a guarantee (validate --seed 41 would
+    # land exactly on a 42-trained stream), so cross-check the manifest.
+    train_seed = (ckpt.metadata or {}).get("sim_seed")
+    val_seed = args.seed + 1
+    if train_seed is not None and val_seed == int(train_seed):
+        val_seed += 1
+    gen = TransactionGenerator(num_users=args.users,
+                               num_merchants=args.merchants, seed=val_seed)
+    scorer.seed_profiles(gen.users.profiles(), gen.merchants.profiles())
+
+    ys, ss = [], []
+    remaining = args.rows
+    while remaining > 0:
+        recs = gen.generate_batch(min(256, remaining))
+        remaining -= len(recs)
+        res = scorer.score_batch(recs)
+        ys += [bool(r.get("is_fraud")) for r in recs]
+        ss += [r["fraud_probability"] for r in res]
+    y = np.asarray(ys, float)
+    s = np.asarray(ss, float)
+    pos = y > 0.5
+    flag = s >= 0.5
+    auc = _auc(y, s)
+    tp = float((flag & pos).sum())
+    report = {
+        "n": int(len(y)),
+        "fraud_rate": round(float(pos.mean()), 4),
+        "auc": round(auc, 4),
+        "accuracy": round(float((flag == pos).mean()), 4),
+        "precision": round(tp / max(float(flag.sum()), 1.0), 4),
+        "recall": round(tp / max(float(pos.sum()), 1.0), 4),
+        "min_auc": args.min_auc,
+        "passed": bool(auc >= args.min_auc),
+        "eval_seed": val_seed,
+        "checkpoint_step": int(ckpt.step),
+    }
+    if args.metrics_out:
+        # Prometheus textfile (node-exporter textfile-collector format) —
+        # the no-egress analog of the reference's pushgateway POST; rendered
+        # by the project's own exposition code so formatting/escaping has
+        # one implementation (obs/metrics.py)
+        from realtime_fraud_detection_tpu.obs.metrics import Registry
+
+        reg = Registry()
+        for k, v in report.items():
+            if isinstance(v, bool):
+                v = int(v)
+            elif not isinstance(v, (int, float)):
+                continue
+            reg.gauge(f"rtfd_validation_{k}",
+                      f"model validation gate: {k}").set(float(v))
+        with open(args.metrics_out, "w") as f:
+            f.write(reg.render())
+    print(json.dumps(report))
+    return 0 if report["passed"] else 1
 
 
 def cmd_bench(args: argparse.Namespace) -> int:
@@ -515,6 +600,17 @@ def build_parser() -> argparse.ArgumentParser:
                     help="also train the LSTM/GNN/BERT branches")
     sp.add_argument("--out", default="./checkpoints")
     sp.set_defaults(fn=cmd_train)
+
+    sp = sub.add_parser("validate",
+                        help="quality-gate a checkpoint on a fresh stream")
+    _add_sim_args(sp)
+    sp.add_argument("--checkpoint-dir", required=True)
+    sp.add_argument("--step", type=int, default=None)
+    sp.add_argument("--rows", type=int, default=4096)
+    sp.add_argument("--min-auc", type=float, default=0.80)
+    sp.add_argument("--metrics-out", default=None,
+                    help="write a Prometheus textfile here")
+    sp.set_defaults(fn=cmd_validate)
 
     sp = sub.add_parser("broker", help="run the durable log broker (TCP)")
     sp.add_argument("--host", default="0.0.0.0")

@@ -59,6 +59,34 @@ class TestTrain:
         assert (tmp_path / "ckpt" / "step_0000000000" / "manifest.json").exists()
 
 
+class TestValidate:
+    def test_validate_gates_on_auc_and_writes_textfile(self, tmp_path, capsys):
+        """train -> validate on a FRESH stream: the reference's
+        model-validation CronJob analog (ci-cd-pipeline.yaml:351-390),
+        exit code = quality gate."""
+        assert main(["train", "--rows", "2500", "--trees", "10",
+                     "--users", "300", "--merchants", "60",
+                     "--out", str(tmp_path / "ckpt")]) == 0
+        capsys.readouterr()
+        prom = tmp_path / "val.prom"
+        rc = main(["validate", "--checkpoint-dir", str(tmp_path / "ckpt"),
+                   "--rows", "1024", "--users", "300", "--merchants", "60",
+                   "--min-auc", "0.6", "--metrics-out", str(prom)])
+        report = json.loads(capsys.readouterr().out.strip().split("\n")[-1])
+        assert rc == 0 and report["passed"] is True
+        assert report["auc"] >= 0.6 and report["n"] == 1024
+        text = prom.read_text()
+        assert "rtfd_validation_auc" in text
+        assert "rtfd_validation_passed 1" in text
+
+        # an unreachable bar fails the job (the CronJob's failure signal)
+        rc = main(["validate", "--checkpoint-dir", str(tmp_path / "ckpt"),
+                   "--rows", "512", "--users", "300", "--merchants", "60",
+                   "--min-auc", "0.999"])
+        report = json.loads(capsys.readouterr().out.strip().split("\n")[-1])
+        assert rc == 1 and report["passed"] is False
+
+
 class TestHealthCheck:
     def test_unreachable_is_unhealthy(self, capsys):
         rc = main(["health-check", "--url", "http://127.0.0.1:1",
