@@ -138,7 +138,8 @@ def cmd_run_job(args: argparse.Namespace) -> int:
     scorer.seed_profiles(gen.users.profiles(), gen.merchants.profiles())
     job = StreamJob(broker, scorer, JobConfig(
         max_batch=args.batch, enable_analytics=args.analytics,
-        enable_enrichment=args.enrichment))
+        enable_enrichment=args.enrichment,
+        pipeline_depth=args.pipeline_depth))
 
     metadata: Optional[MetadataStore] = None
     ckpt: Optional[CheckpointManager] = None
@@ -569,6 +570,11 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--state", default="",
                     help="shared state server host:port (RESP)")
     sp.add_argument("--batch", type=int, default=256)
+    sp.add_argument("--pipeline-depth", type=int, default=2,
+                    help="in-flight microbatches (3 overlaps the result "
+                         "transfer with a full batch period; see "
+                         "JobConfig.pipeline_depth for the state-staleness "
+                         "tradeoff)")
     sp.add_argument("--analytics", action="store_true",
                     help="attach the windowed-analytics stage")
     sp.add_argument("--enrichment", action="store_true",
