@@ -734,6 +734,10 @@ def run_bench() -> None:
             "window_s": round(dt, 1),
             "sustained": bool(on_tpu),
             "batches": job.counters["batches"],
+            # configuration the number was measured under
+            "pipeline_depth": job.config.pipeline_depth,
+            "transfer_bf16": scorer.sc.transfer_bf16,
+            "max_batch": job.config.max_batch,
         }
 
         # detection quality from the soak's own predictions
