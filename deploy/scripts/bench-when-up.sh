@@ -1,0 +1,29 @@
+#!/bin/bash
+# Run the benchmark as soon as the TPU backend is reachable.
+#
+# The development TPU sits behind a relay whose availability flaps on
+# tens-of-minutes timescales (backend init HANGS rather than failing —
+# see .claude/skills/verify/SKILL.md). This probes cheaply on an interval
+# and fires `python bench.py` exactly once, the first time a probe
+# answers. `timeout -k` matters: a wedged probe ignores plain SIGTERM.
+#
+# Usage: deploy/scripts/bench-when-up.sh [out.json] [max_probes] [gap_s]
+set -u
+OUT="${1:-bench_out.json}"
+MAX_PROBES="${2:-60}"
+GAP_S="${3:-300}"
+cd "$(dirname "$0")/../.."
+
+for i in $(seq 1 "$MAX_PROBES"); do
+  echo "[bench-when-up] probe $i/$MAX_PROBES at $(date -u +%H:%M:%S)" >&2
+  if timeout -k 10 150 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    echo "[bench-when-up] backend up; running bench" >&2
+    python bench.py > "$OUT"
+    rc=$?
+    echo "[bench-when-up] bench rc=$rc -> $OUT" >&2
+    exit "$rc"
+  fi
+  sleep "$GAP_S"
+done
+echo "[bench-when-up] backend never came up after $MAX_PROBES probes" >&2
+exit 3
