@@ -18,10 +18,15 @@ for i in $(seq 1 "$MAX_PROBES"); do
   echo "[bench-when-up] probe $i/$MAX_PROBES at $(date -u +%H:%M:%S)" >&2
   if timeout -k 10 150 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
     echo "[bench-when-up] backend up; running bench" >&2
-    python bench.py > "$OUT"
+    # the relay can wedge BETWEEN the probe and (or during) the bench —
+    # same hang-not-fail failure mode, same hard-kill timeout treatment;
+    # on a timeout keep probing instead of hanging forever
+    if timeout -k 30 2400 python bench.py > "$OUT"; then
+      echo "[bench-when-up] bench ok -> $OUT" >&2
+      exit 0
+    fi
     rc=$?
-    echo "[bench-when-up] bench rc=$rc -> $OUT" >&2
-    exit "$rc"
+    echo "[bench-when-up] bench rc=$rc (timeout/wedge?); resuming probes" >&2
   fi
   sleep "$GAP_S"
 done

@@ -89,6 +89,13 @@ class ServingApp:
         # _score_batch_sync, so serialize them (the device is serial anyway)
         self._score_lock = threading.Lock()
         self._started = time.monotonic()
+        # admission control (reference config.py:86 max_concurrent_
+        # predictions, enforced): transactions admitted but not yet
+        # answered. Beyond the cap, requests get an immediate 503 instead
+        # of growing the microbatch queue without bound — load sheds at
+        # the door, and the deadline batcher's latency contract holds for
+        # everything admitted. Single event loop => plain counter.
+        self._inflight_txns = 0
         self._register_routes()
 
     # --------------------------------------------------------------- scoring
@@ -200,14 +207,46 @@ class ServingApp:
         r("POST", "/experiments", self._create_experiment)
         r("GET", "/experiments", self._experiment_results)
 
+    def _admit(self, n: int) -> None:
+        limit = self.config.serving.max_concurrent_predictions
+        if self._inflight_txns + n > limit:
+            self.metrics.record_error("at_capacity")
+            raise HttpError(
+                503, f"at capacity ({self._inflight_txns} in flight, "
+                     f"limit {limit})")
+        self._inflight_txns += n
+
+    def _release_on_done(self, fut: "asyncio.Future", n: int) -> None:
+        """Free n admission slots when the batcher resolves ``fut`` — NOT
+        when the HTTP waiter gives up. A timed-out request's transaction
+        still sits in the microbatch queue and will be scored; releasing
+        its slot early would let new admissions stack on top of abandoned
+        work and grow the queue without bound."""
+        def _done(f: "asyncio.Future") -> None:
+            self._inflight_txns -= n
+            if not f.cancelled():
+                f.exception()        # consume, silencing "never retrieved"
+        fut.add_done_callback(_done)
+
     async def _predict(self, body, query) -> Tuple[int, Any]:
         txn, errors = validate_transaction(body)
         if errors:
             raise HttpError(422, errors)
         timeout = self.config.serving.prediction_timeout_seconds
+        self._admit(1)
         try:
-            result = await asyncio.wait_for(
-                self.batcher.submit(txn), timeout=timeout)
+            fut = self.batcher.submit_nowait(txn)
+        except (asyncio.QueueFull, RuntimeError):
+            self._inflight_txns -= 1
+            self.metrics.record_error("at_capacity")
+            raise HttpError(503, "scoring queue full")
+        self._release_on_done(fut, 1)
+        try:
+            # shield: the waiter's timeout must not cancel the scoring —
+            # the batch containing this txn is already (or will be) on the
+            # device; the slot frees via _release_on_done either way
+            result = await asyncio.wait_for(asyncio.shield(fut),
+                                            timeout=timeout)
         except asyncio.TimeoutError:
             self.metrics.record_error("timeout")
             raise HttpError(408, "prediction timed out")
@@ -219,10 +258,22 @@ class ServingApp:
             body, self.config.serving.batch_size_limit)
         if errors:
             raise HttpError(422, errors)
+        limit = self.config.serving.max_concurrent_predictions
+        if len(txns) > limit:
+            # oversize, not overload: no amount of retrying can ever fit
+            # this batch under the concurrency cap, so reject it as
+            # non-retryable instead of a transient 503
+            raise HttpError(
+                413, f"batch of {len(txns)} exceeds the concurrency "
+                     f"capacity {limit}; split into smaller batches")
         t0 = time.perf_counter()
-        loop = asyncio.get_running_loop()
-        results = await loop.run_in_executor(
-            None, self._score_batch_sync, txns)
+        self._admit(len(txns))
+        try:
+            loop = asyncio.get_running_loop()
+            results = await loop.run_in_executor(
+                None, self._score_batch_sync, txns)
+        finally:
+            self._inflight_txns -= len(txns)
         return 200, {
             "results": results,
             "count": len(results),

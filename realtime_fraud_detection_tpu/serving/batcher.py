@@ -61,6 +61,20 @@ class RequestMicrobatcher:
         return self._queue.qsize()
 
     # --------------------------------------------------------------- submit
+    def submit_nowait(self, txn: Mapping[str, Any]) -> asyncio.Future:
+        """Enqueue one transaction, returning its result future.
+
+        For callers that manage the wait themselves (the serving app holds
+        its admission slot until THIS future resolves — a waiter timing out
+        must not free capacity while the transaction still sits in the
+        queue). Raises asyncio.QueueFull if the queue is at max_queue.
+        """
+        if self._closed:
+            raise RuntimeError("microbatcher is stopped")
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._queue.put_nowait((txn, fut))
+        return fut
+
     async def submit(self, txn: Mapping[str, Any]) -> Dict[str, Any]:
         """Enqueue one transaction; resolves to its FraudPrediction dict."""
         if self._closed:

@@ -229,6 +229,36 @@ class TestEndpoints:
         _, health = _request(app.port, "GET", "/health")
         assert health["prediction_cache"]["hits"] >= 1
 
+    def test_admission_control_sheds_load_at_capacity(self, app_server):
+        """max_concurrent_predictions (reference config.py:86) is enforced:
+        beyond the cap the request gets an immediate 503, and the in-flight
+        counter returns to zero so service resumes."""
+        app, gen = app_server
+        limit_before = app.config.serving.max_concurrent_predictions
+        app.config.serving.max_concurrent_predictions = 5
+        try:
+            # oversize (can NEVER fit): non-retryable 413, not 503
+            status, data = _request(app.port, "POST", "/batch-predict",
+                                    {"transactions": gen.generate_batch(10)})
+            assert status == 413
+            assert "split into smaller batches" in json.dumps(data)
+            assert app._inflight_txns == 0
+            # transient overload (fits when load drains): 503
+            app._inflight_txns = 3
+            status, data = _request(app.port, "POST", "/batch-predict",
+                                    {"transactions": gen.generate_batch(4)})
+            assert status == 503
+            assert "at capacity" in json.dumps(data)
+            assert app._inflight_txns == 3
+            app._inflight_txns = 0
+            # within the cap: served normally, counter drains
+            status, data = _request(app.port, "POST", "/batch-predict",
+                                    {"transactions": gen.generate_batch(4)})
+            assert status == 200 and data["count"] == 4
+            assert app._inflight_txns == 0
+        finally:
+            app.config.serving.max_concurrent_predictions = limit_before
+
     def test_prediction_cache_unit_ttl_and_eviction(self):
         from realtime_fraud_detection_tpu.serving.cache import PredictionCache
 
