@@ -24,9 +24,15 @@ for i in $(seq 1 "$MAX_PROBES"); do
     if timeout -k 30 2400 python bench.py > "$OUT"; then
       echo "[bench-when-up] bench ok -> $OUT" >&2
       exit 0
+    else
+      rc=$?
+      if [ "$rc" -lt 124 ]; then
+        # deterministic bench failure, not a wedge: retrying won't help
+        echo "[bench-when-up] bench FAILED rc=$rc -> giving up" >&2
+        exit "$rc"
+      fi
+      echo "[bench-when-up] bench timed out (rc=$rc, wedge?); resuming probes" >&2
     fi
-    rc=$?
-    echo "[bench-when-up] bench rc=$rc (timeout/wedge?); resuming probes" >&2
   fi
   sleep "$GAP_S"
 done

@@ -56,6 +56,12 @@ class EnsembleParams:
     strategy: int = struct.field(pytree_node=False, default=WEIGHTED_AVERAGE)
     fraud_threshold: float = struct.field(pytree_node=False, default=0.5)
     confidence_threshold: float = struct.field(pytree_node=False, default=0.7)
+    # decision-ladder rungs (ensemble_predictor.py:344-356; configurable in
+    # the reference's EnsembleConfig) — static so XLA folds them into the
+    # compiled ladder; changing them recompiles, like any threshold change
+    decline_threshold: float = struct.field(pytree_node=False, default=0.95)
+    review_threshold: float = struct.field(pytree_node=False, default=0.8)
+    monitor_threshold: float = struct.field(pytree_node=False, default=0.6)
 
     @classmethod
     def from_config(cls, config: Config, model_names: Sequence[str]) -> "EnsembleParams":
@@ -72,6 +78,9 @@ class EnsembleParams:
             strategy=STRATEGIES.index(config.ensemble.strategy),
             fraud_threshold=config.ensemble.fraud_threshold,
             confidence_threshold=config.ensemble.confidence_threshold,
+            decline_threshold=config.ensemble.decline_threshold,
+            review_threshold=config.ensemble.review_threshold,
+            monitor_threshold=config.ensemble.monitor_threshold,
         )
 
 
@@ -122,7 +131,10 @@ def combine_predictions(
     else:
         prob, confidence = stack_prob, stack_conf
 
-    decision = ensemble_decision(prob, confidence, params.confidence_threshold)
+    decision = ensemble_decision(
+        prob, confidence, params.confidence_threshold,
+        decline=params.decline_threshold, review=params.review_threshold,
+        monitor=params.monitor_threshold)
     out = {
         "fraud_probability": prob,
         "confidence": confidence,
@@ -135,12 +147,16 @@ def combine_predictions(
 
 
 def ensemble_decision(
-    prob: jax.Array, confidence: jax.Array, confidence_threshold: float = 0.7
+    prob: jax.Array, confidence: jax.Array, confidence_threshold: float = 0.7,
+    decline: float = 0.95, review: float = 0.8, monitor: float = 0.6,
 ) -> jax.Array:
-    """Decision ladder (ensemble_predictor.py:344-356)."""
+    """Decision ladder (ensemble_predictor.py:344-356). Rungs come from
+    EnsembleConfig — the reference declares them configurable and so do we
+    (config.py decline/review/monitor_threshold)."""
     by_prob = jnp.where(
-        prob >= 0.95, DECLINE,
-        jnp.where(prob >= 0.8, REVIEW,
-                  jnp.where(prob >= 0.6, APPROVE_WITH_MONITORING, APPROVE)),
+        prob >= decline, DECLINE,
+        jnp.where(prob >= review, REVIEW,
+                  jnp.where(prob >= monitor, APPROVE_WITH_MONITORING,
+                            APPROVE)),
     )
     return jnp.where(confidence < confidence_threshold, REVIEW, by_prob).astype(jnp.int32)

@@ -30,17 +30,21 @@ VERY_LOW, LOW, MEDIUM, HIGH, CRITICAL = range(5)
 
 
 def ensemble_decision_name(prob: float, confidence: float,
-                           confidence_threshold: float = 0.7) -> str:
+                           confidence_threshold: float = 0.7,
+                           decline: float = 0.95, review: float = 0.8,
+                           monitor: float = 0.6) -> str:
     """Host-side scalar twin of ``ensemble.combine.ensemble_decision``
-    (ensemble_predictor.py:344-356). One source of truth for the thresholds
-    shared by the device ladder and host-side consumers (A/B reweighting)."""
+    (ensemble_predictor.py:344-356). Rung defaults match the device ladder;
+    callers serving configured rungs must pass the SAME values here (the
+    serving A/B path passes config.ensemble's) or variant-arm decisions
+    would diverge from the compiled ladder."""
     if confidence < confidence_threshold:
         return DECISIONS[REVIEW]
-    if prob >= 0.95:
+    if prob >= decline:
         return DECISIONS[DECLINE]
-    if prob >= 0.8:
+    if prob >= review:
         return DECISIONS[REVIEW]
-    if prob >= 0.6:
+    if prob >= monitor:
         return DECISIONS[APPROVE_WITH_MONITORING]
     return DECISIONS[APPROVE]
 

@@ -197,13 +197,26 @@ class FraudScorer:
                 SharedVelocityStore,
             )
 
+            st = self.config.state
             self.profiles = SharedProfileStore(state_client)
             self.velocity = SharedVelocityStore(state_client)
-            self.txn_cache = SharedTransactionCache(state_client)
+            self.txn_cache = SharedTransactionCache(
+                state_client,
+                txn_ttl_s=st.transaction_ttl_s,
+                features_ttl_s=st.features_ttl_s,
+                user_list_len=st.user_history_len,
+                merchant_list_len=st.merchant_history_len,
+            )
         else:
+            st = self.config.state
             self.profiles = ProfileStore()
             self.velocity = VelocityStore()
-            self.txn_cache = TransactionCache()
+            self.txn_cache = TransactionCache(
+                txn_ttl_s=st.transaction_ttl_s,
+                features_ttl_s=st.features_ttl_s,
+                user_list_len=st.user_history_len,
+                merchant_list_len=st.merchant_history_len,
+            )
         self.history = UserHistoryStore(self.sc.seq_len, self.sc.feature_dim)
         self.graph = EntityGraphStore(self.sc.fanout)
         self.tokenizer = FraudTokenizer(

@@ -176,10 +176,14 @@ class ServingApp:
             for name in self.ab.active_experiments():
                 variant = self.ab.assign(name, uid)
                 if variant.overrides.get("weights"):
+                    ens = self.config.ensemble
                     reweighted = apply_weight_overrides(
                         res["model_predictions"], base,
                         variant.overrides["weights"],
-                        self.config.ensemble.confidence_threshold)
+                        ens.confidence_threshold,
+                        decline_threshold=ens.decline_threshold,
+                        review_threshold=ens.review_threshold,
+                        monitor_threshold=ens.monitor_threshold)
                     if reweighted is not None:
                         # decision + risk_level are recomputed with the new
                         # score so the served record stays consistent

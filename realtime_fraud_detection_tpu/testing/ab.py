@@ -34,7 +34,10 @@ def apply_weight_overrides(
         model_predictions: Mapping[str, float],
         base_weights: Mapping[str, float],
         overrides: Mapping[str, float],
-        confidence_threshold: float = 0.7) -> Optional[Dict[str, Any]]:
+        confidence_threshold: float = 0.7,
+        decline_threshold: float = 0.95,
+        review_threshold: float = 0.8,
+        monitor_threshold: float = 0.6) -> Optional[Dict[str, Any]]:
     """Re-combine per-model predictions under variant weight overrides.
 
     The fused scorer returns every branch's prediction, so a variant that
@@ -69,8 +72,10 @@ def apply_weight_overrides(
     prob = num / den
     confidence = conf_num / den
     return {"fraud_probability": prob, "confidence": confidence,
-            "decision": ensemble_decision_name(prob, confidence,
-                                               confidence_threshold),
+            "decision": ensemble_decision_name(
+                prob, confidence, confidence_threshold,
+                decline=decline_threshold, review=review_threshold,
+                monitor=monitor_threshold),
             "risk_level": risk_level_name(prob)}
 
 

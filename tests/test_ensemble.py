@@ -119,3 +119,42 @@ class TestDecisionLadder:
         assert out["fraud_probability"].shape == (256,)
         assert out["decision"].shape == (256,)
         assert np.isin(_np(out["decision"]), [0, 1, 2, 3]).all()
+
+
+def test_decision_ladder_rungs_come_from_config():
+    """decline/review/monitor_threshold are config knobs (EnsembleConfig),
+    not constants baked into the ladder."""
+
+    from realtime_fraud_detection_tpu.ensemble.combine import EnsembleParams
+    from realtime_fraud_detection_tpu.features.rules import DECISIONS
+    from realtime_fraud_detection_tpu.scoring import MODEL_NAMES
+    from realtime_fraud_detection_tpu.utils.config import Config
+
+    cfg = Config()
+    cfg.ensemble.confidence_threshold = 0.0   # isolate the prob rungs
+    cfg.ensemble.decline_threshold = 0.5
+    cfg.ensemble.review_threshold = 0.4
+    cfg.ensemble.monitor_threshold = 0.3
+    params = EnsembleParams.from_config(cfg, list(MODEL_NAMES))
+    # every branch votes 0.45 with full confidence multipliers: probability
+    # 0.45 sits in the custom REVIEW band (>=0.4, <0.5)
+    preds = np.full((1, 5), 0.45, np.float32)
+    out = combine_predictions(preds, np.ones((1, 5), bool), params)
+    assert DECISIONS[int(np.asarray(out["decision"])[0])] == "REVIEW"
+
+    cfg.ensemble.decline_threshold = 0.44   # now the same score DECLINEs
+    params2 = EnsembleParams.from_config(cfg, list(MODEL_NAMES))
+    out2 = combine_predictions(preds, np.ones((1, 5), bool), params2)
+    assert DECISIONS[int(np.asarray(out2["decision"])[0])] == "DECLINE"
+
+
+def test_scorer_state_ttls_come_from_config():
+    from realtime_fraud_detection_tpu.scoring import FraudScorer
+    from realtime_fraud_detection_tpu.utils.config import Config
+
+    cfg = Config()
+    cfg.state.transaction_ttl_s = 123
+    cfg.state.user_history_len = 7
+    s = FraudScorer(config=cfg)
+    assert s.txn_cache.txn_ttl_s == 123
+    assert s.txn_cache.user_list_len == 7
