@@ -26,12 +26,13 @@ for i in $(seq 1 "$MAX_PROBES"); do
       exit 0
     else
       rc=$?
-      if [ "$rc" -lt 124 ]; then
-        # deterministic bench failure, not a wedge: retrying won't help
-        echo "[bench-when-up] bench FAILED rc=$rc -> giving up" >&2
-        exit "$rc"
-      fi
-      echo "[bench-when-up] bench timed out (rc=$rc, wedge?); resuming probes" >&2
+      case "$rc" in
+        124|137)   # timeout's TERM / -k KILL: a wedge, keep probing
+          echo "[bench-when-up] bench timed out (rc=$rc, wedge?); resuming probes" >&2 ;;
+        *)         # deterministic failure (incl. 125-127): retrying won't help
+          echo "[bench-when-up] bench FAILED rc=$rc -> giving up" >&2
+          exit "$rc" ;;
+      esac
     fi
   fi
   sleep "$GAP_S"

@@ -32,7 +32,10 @@ from realtime_fraud_detection_tpu.features.rules import (
     APPROVE,
     APPROVE_WITH_MONITORING,
     DECLINE,
+    DECLINE_THRESHOLD_DEFAULT,
+    MONITOR_THRESHOLD_DEFAULT,
     REVIEW,
+    REVIEW_THRESHOLD_DEFAULT,
     risk_level_code,
 )
 from realtime_fraud_detection_tpu.utils.config import (
@@ -58,10 +61,14 @@ class EnsembleParams:
     confidence_threshold: float = struct.field(pytree_node=False, default=0.7)
     # decision-ladder rungs (ensemble_predictor.py:344-356; configurable in
     # the reference's EnsembleConfig) — static so XLA folds them into the
-    # compiled ladder; changing them recompiles, like any threshold change
-    decline_threshold: float = struct.field(pytree_node=False, default=0.95)
-    review_threshold: float = struct.field(pytree_node=False, default=0.8)
-    monitor_threshold: float = struct.field(pytree_node=False, default=0.6)
+    # compiled ladder; changing them recompiles, like any threshold change.
+    # Defaults come from the one shared definition in features/rules.py.
+    decline_threshold: float = struct.field(
+        pytree_node=False, default=DECLINE_THRESHOLD_DEFAULT)
+    review_threshold: float = struct.field(
+        pytree_node=False, default=REVIEW_THRESHOLD_DEFAULT)
+    monitor_threshold: float = struct.field(
+        pytree_node=False, default=MONITOR_THRESHOLD_DEFAULT)
 
     @classmethod
     def from_config(cls, config: Config, model_names: Sequence[str]) -> "EnsembleParams":
@@ -148,7 +155,9 @@ def combine_predictions(
 
 def ensemble_decision(
     prob: jax.Array, confidence: jax.Array, confidence_threshold: float = 0.7,
-    decline: float = 0.95, review: float = 0.8, monitor: float = 0.6,
+    decline: float = DECLINE_THRESHOLD_DEFAULT,
+    review: float = REVIEW_THRESHOLD_DEFAULT,
+    monitor: float = MONITOR_THRESHOLD_DEFAULT,
 ) -> jax.Array:
     """Decision ladder (ensemble_predictor.py:344-356). Rungs come from
     EnsembleConfig — the reference declares them configurable and so do we

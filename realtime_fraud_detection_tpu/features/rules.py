@@ -29,10 +29,20 @@ RISK_LEVEL_NAMES: tuple[str, ...] = (
 VERY_LOW, LOW, MEDIUM, HIGH, CRITICAL = range(5)
 
 
+# One shared rung-default definition (utils/config.py) re-exported for the
+# device ladder (ensemble/combine.py) and this host-side twin.
+from realtime_fraud_detection_tpu.utils.config import (  # noqa: E402
+    DECLINE_THRESHOLD_DEFAULT,
+    MONITOR_THRESHOLD_DEFAULT,
+    REVIEW_THRESHOLD_DEFAULT,
+)
+
+
 def ensemble_decision_name(prob: float, confidence: float,
                            confidence_threshold: float = 0.7,
-                           decline: float = 0.95, review: float = 0.8,
-                           monitor: float = 0.6) -> str:
+                           decline: float = DECLINE_THRESHOLD_DEFAULT,
+                           review: float = REVIEW_THRESHOLD_DEFAULT,
+                           monitor: float = MONITOR_THRESHOLD_DEFAULT) -> str:
     """Host-side scalar twin of ``ensemble.combine.ensemble_decision``
     (ensemble_predictor.py:344-356). Rung defaults match the device ladder;
     callers serving configured rungs must pass the SAME values here (the

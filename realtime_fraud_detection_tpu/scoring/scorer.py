@@ -189,7 +189,21 @@ class FraudScorer:
         # microbatcher — no network hop in the hot loop). With
         # ``state_client`` (a state.RespClient), profiles/velocity/txn-cache
         # move to the shared RESP tier so N replicas share one state plane
-        # (state/shared.py; the reference's Redis role).
+        # (state/shared.py; the reference's Redis role). Config alone can
+        # select the shared tier too: state.backend="redis" connects to
+        # state.redis_host:redis_port (the reference's REDIS_HOST/PORT env
+        # contract) when no explicit client is passed.
+        st = self.config.state
+        cache_kwargs = dict(
+            txn_ttl_s=st.transaction_ttl_s,
+            features_ttl_s=st.features_ttl_s,
+            user_list_len=st.user_history_len,
+            merchant_list_len=st.merchant_history_len,
+        )
+        if state_client is None and st.backend == "redis":
+            from realtime_fraud_detection_tpu.state import RespClient
+
+            state_client = RespClient(host=st.redis_host, port=st.redis_port)
         if state_client is not None:
             from realtime_fraud_detection_tpu.state.shared import (
                 SharedProfileStore,
@@ -197,26 +211,14 @@ class FraudScorer:
                 SharedVelocityStore,
             )
 
-            st = self.config.state
             self.profiles = SharedProfileStore(state_client)
             self.velocity = SharedVelocityStore(state_client)
-            self.txn_cache = SharedTransactionCache(
-                state_client,
-                txn_ttl_s=st.transaction_ttl_s,
-                features_ttl_s=st.features_ttl_s,
-                user_list_len=st.user_history_len,
-                merchant_list_len=st.merchant_history_len,
-            )
+            self.txn_cache = SharedTransactionCache(state_client,
+                                                    **cache_kwargs)
         else:
-            st = self.config.state
             self.profiles = ProfileStore()
             self.velocity = VelocityStore()
-            self.txn_cache = TransactionCache(
-                txn_ttl_s=st.transaction_ttl_s,
-                features_ttl_s=st.features_ttl_s,
-                user_list_len=st.user_history_len,
-                merchant_list_len=st.merchant_history_len,
-            )
+            self.txn_cache = TransactionCache(**cache_kwargs)
         self.history = UserHistoryStore(self.sc.seq_len, self.sc.feature_dim)
         self.graph = EntityGraphStore(self.sc.fanout)
         self.tokenizer = FraudTokenizer(
@@ -452,32 +454,40 @@ class FraudScorer:
 
         results = []
         weights = np.asarray(self.ensemble_params.weights)
+        with_explanation = self.config.ensemble.enable_explanation
         for i, rec in enumerate(records):
             model_predictions = {
                 name: float(preds[i, j])
                 for j, name in enumerate(MODEL_NAMES) if self.model_valid[j]
             }
-            factors = []
-            if high_amount[i]:
-                factors.append("high_transaction_amount")
-            if unusual_hour[i]:
-                factors.append("unusual_transaction_hour")
-            if high_risk_payment[i]:
-                factors.append("high_risk_payment_method")
-            contributions = {
-                name: float(weights[j] * preds[i, j])
-                for j, name in enumerate(MODEL_NAMES) if self.model_valid[j]
-            }
-            explanation = {
-                "model_contributions": contributions,
-                "key_factors": factors,
-                "rule_score": float(rule[i]),
-            }
-            if self._top_importances is not None:
-                # fresh dict per response: a consumer mutating one
-                # explanation must not corrupt its batch-mates
-                explanation["top_feature_importances"] = dict(
-                    self._top_importances)
+            if with_explanation:
+                factors = []
+                if high_amount[i]:
+                    factors.append("high_transaction_amount")
+                if unusual_hour[i]:
+                    factors.append("unusual_transaction_hour")
+                if high_risk_payment[i]:
+                    factors.append("high_risk_payment_method")
+                contributions = {
+                    name: float(weights[j] * preds[i, j])
+                    for j, name in enumerate(MODEL_NAMES)
+                    if self.model_valid[j]
+                }
+                explanation = {
+                    "model_contributions": contributions,
+                    "key_factors": factors,
+                    "rule_score": float(rule[i]),
+                }
+                if self._top_importances is not None:
+                    # fresh dict per response: a consumer mutating one
+                    # explanation must not corrupt its batch-mates
+                    explanation["top_feature_importances"] = dict(
+                        self._top_importances)
+            else:
+                # ensemble.enable_explanation=False (reference config.py:85
+                # analog): schema keeps the key, host skips the per-record
+                # dict assembly
+                explanation = {}
             results.append({
                 "transaction_id": str(rec.get("transaction_id", "")),
                 "fraud_probability": float(probs[i]),

@@ -80,8 +80,8 @@ class ServingApp:
         from realtime_fraud_detection_tpu.serving.cache import PredictionCache
 
         self.prediction_cache = (
-            PredictionCache(sc.prediction_cache_ttl_seconds,
-                            sc.prediction_cache_max_entries)
+            PredictionCache(self.config.ensemble.cache_ttl_seconds,
+                            self.config.ensemble.cache_max_entries)
             if sc.enable_prediction_cache else None
         )
         # FraudScorer and the drift monitor are single-writer; /predict's

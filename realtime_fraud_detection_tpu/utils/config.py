@@ -45,6 +45,16 @@ class ModelConfig:
     hyperparameters: Dict[str, Any] = field(default_factory=dict)
 
 
+# Decision-ladder rung defaults (ensemble_predictor.py:344-356) — the ONE
+# definition shared by EnsembleConfig, EnsembleParams, the compiled ladder
+# (ensemble/combine.py) and its host-side twin (features/rules.py), so a
+# default can't silently drift between them. Lives here because this module
+# has no heavy deps and everything else already imports it.
+DECLINE_THRESHOLD_DEFAULT = 0.95
+REVIEW_THRESHOLD_DEFAULT = 0.8
+MONITOR_THRESHOLD_DEFAULT = 0.6
+
+
 @dataclass
 class EnsembleConfig:
     """Ensemble strategy + decision thresholds (config.py:21-27)."""
@@ -53,10 +63,12 @@ class EnsembleConfig:
     confidence_threshold: float = 0.7
     fraud_threshold: float = 0.5
     enable_explanation: bool = True
-    # Decision ladder (ensemble_predictor.py:344-356)
-    decline_threshold: float = 0.95
-    review_threshold: float = 0.8
-    monitor_threshold: float = 0.6
+    # Decision ladder (ensemble_predictor.py:344-356); validate() enforces
+    # 0 <= monitor <= review <= decline <= 1 (a misordered ladder would
+    # silently shadow rungs)
+    decline_threshold: float = DECLINE_THRESHOLD_DEFAULT
+    review_threshold: float = REVIEW_THRESHOLD_DEFAULT
+    monitor_threshold: float = MONITOR_THRESHOLD_DEFAULT
     # Prediction cache (ensemble_predictor.py:57-58, 460-471)
     cache_ttl_seconds: float = 300.0
     cache_max_entries: int = 1000
@@ -82,13 +94,12 @@ class ServingConfig:
     # Microbatcher: fixed-latency deadline + max batch
     microbatch_deadline_ms: float = 5.0
     microbatch_max_size: int = 256
-    # Prediction TTL cache (reference ensemble_predictor.py:437-471:
-    # 300 s TTL, max 1000 entries, evict-oldest), keyed by transaction_id —
-    # idempotent retries of the same transaction serve the cached §2.7
-    # response without re-scoring
+    # Prediction TTL cache switch (reference ensemble_predictor.py:437-471),
+    # keyed by transaction_id — idempotent retries of the same transaction
+    # serve the cached §2.7 response without re-scoring. TTL/size come from
+    # EnsembleConfig.cache_ttl_seconds / cache_max_entries (the reference
+    # keeps the cache knobs on the ensemble config; one source of truth).
     enable_prediction_cache: bool = True
-    prediction_cache_ttl_seconds: float = 300.0
-    prediction_cache_max_entries: int = 1000
 
 
 @dataclass
@@ -135,7 +146,9 @@ class StateConfig:
     redis_port: int = 6379
     transaction_ttl_s: int = 24 * 3600
     features_ttl_s: int = 2 * 3600
-    velocity_ttl_s: int = 3600
+    # NOTE deliberately no velocity TTL knob: velocity keys expire at their
+    # own window period by design (state/shared.py — this FIXES the
+    # reference's uniform 1h TTL, which let a 24h velocity hash die early)
     user_history_len: int = 100  # RedisService.java:296-306 last-100 list
     merchant_history_len: int = 500
 
@@ -300,6 +313,17 @@ class Config:
                 f"ensemble.strategy (env RTFD_ENSEMBLE_STRATEGY) must be one of "
                 f"{VALID_STRATEGIES}, got {self.ensemble.strategy!r}"
             )
+        e = self.ensemble
+        if not (0.0 <= e.monitor_threshold <= e.review_threshold
+                <= e.decline_threshold <= 1.0):
+            # a misordered ladder silently shadows rungs (e.g. review 0.4 <
+            # monitor 0.6 makes APPROVE_WITH_MONITORING unreachable) —
+            # refuse it loudly, this is a fraud-decision path
+            raise ValueError(
+                "decision ladder must satisfy 0 <= monitor_threshold <= "
+                "review_threshold <= decline_threshold <= 1, got "
+                f"monitor={e.monitor_threshold} review={e.review_threshold} "
+                f"decline={e.decline_threshold}")
 
 
 def _merge_dataclass(obj: Any, data: Dict[str, Any]) -> None:

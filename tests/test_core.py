@@ -179,3 +179,15 @@ class TestReviewRegressions:
             {"amount": 100.0},
         ])
         assert list(rows[0].keys()) == list(rows[1].keys())
+
+
+def test_misordered_decision_ladder_is_rejected():
+    from realtime_fraud_detection_tpu.utils.config import Config
+
+    cfg = Config()
+    cfg.ensemble.review_threshold = 0.4
+    cfg.ensemble.monitor_threshold = 0.6   # shadows the monitor rung
+    import pytest
+
+    with pytest.raises(ValueError, match="decision ladder"):
+        cfg.validate()

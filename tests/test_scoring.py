@@ -126,3 +126,18 @@ def test_fraud_scorer_padding_invariance():
     r8 = run(recs[:8])   # exact bucket
     for a, b in zip(r5, r8[:5]):
         assert a["fraud_probability"] == pytest.approx(b["fraud_probability"], rel=1e-5)
+
+
+def test_enable_explanation_config_gates_explanations():
+    from realtime_fraud_detection_tpu.scoring import FraudScorer
+    from realtime_fraud_detection_tpu.sim.simulator import TransactionGenerator
+    from realtime_fraud_detection_tpu.utils.config import Config
+
+    gen = TransactionGenerator(num_users=16, num_merchants=8, seed=2)
+    cfg = Config()
+    cfg.ensemble.enable_explanation = False
+    s = FraudScorer(config=cfg)
+    s.seed_profiles(gen.users.profiles(), gen.merchants.profiles())
+    res = s.score_batch(gen.generate_batch(4))
+    assert all(r["explanation"] == {} for r in res)
+    assert all("fraud_probability" in r for r in res)
