@@ -200,10 +200,15 @@ class FraudScorer:
             user_list_len=st.user_history_len,
             merchant_list_len=st.merchant_history_len,
         )
+        self._owned_state_client = None
         if state_client is None and st.backend == "redis":
             from realtime_fraud_detection_tpu.state import RespClient
 
             state_client = RespClient(host=st.redis_host, port=st.redis_port)
+            # config-driven connection: this scorer owns the socket and
+            # close() releases it (an explicitly passed client stays the
+            # caller's to manage)
+            self._owned_state_client = state_client
         if state_client is not None:
             from realtime_fraud_detection_tpu.state.shared import (
                 SharedProfileStore,
@@ -515,6 +520,15 @@ class FraudScorer:
             merged["risk_level"] = res["risk_level"]
             merged["confidence"] = res["confidence"]
             self.txn_cache.cache_transaction(merged, now=ts)
+
+    def close(self) -> None:
+        """Release resources this scorer owns (currently: the state-tier
+        connection it auto-created for config.state.backend="redis")."""
+        if self._owned_state_client is not None:
+            try:
+                self._owned_state_client.close()
+            finally:
+                self._owned_state_client = None
 
     # ------------------------------------------------------------------ info
     def model_info(self) -> Dict[str, Any]:

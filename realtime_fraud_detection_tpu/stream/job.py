@@ -66,6 +66,14 @@ class JobConfig:
     # to D-1 batches' updates (vs 1 at the default depth 2). Raise depth for
     # throughput soaks; keep 2 where freshest velocity features matter.
     pipeline_depth: int = 2
+    # topic names (reference JobConfig.java topic parameters); defaults are
+    # the §2.5 contract (stream/topics.py) — overridable per deployment,
+    # e.g. the reference's test-transactions topic for shadow traffic
+    transactions_topic: str = T.TRANSACTIONS
+    predictions_topic: str = T.PREDICTIONS
+    alerts_topic: str = T.ALERTS
+    enriched_topic: str = T.ENRICHED
+    features_topic: str = T.FEATURES
 
 
 @dataclasses.dataclass
@@ -108,7 +116,7 @@ class StreamJob:
         self.scorer = scorer
         self.config = config or JobConfig()
         self.consumer = broker.consumer(
-            [T.TRANSACTIONS], self.config.group_id, faults
+            [self.config.transactions_topic], self.config.group_id, faults
         )
         self.assembler = MicrobatchAssembler(
             self.consumer,
@@ -263,7 +271,7 @@ class StreamJob:
                 "explanation": {"error": True, "validation_errors": errors},
             }
             self.counters["errors"] += 1
-            self.broker.produce(T.PREDICTIONS, res,
+            self.broker.produce(self.config.predictions_topic, res,
                                 key=str(value.get("user_id", "")))
             results.append(res)
         return results
@@ -277,7 +285,7 @@ class StreamJob:
         for rec, cached in ctx.cached_dups:
             value = rec.value if isinstance(rec.value, dict) else {}
             self.broker.produce(
-                T.PREDICTIONS,
+                self.config.predictions_topic,
                 {
                     "transaction_id": str(cached.get("transaction_id") or
                                           value.get("transaction_id", "")),
@@ -327,9 +335,10 @@ class StreamJob:
 
         for i, (rec, res) in enumerate(zip(fresh, results)):
             uid = str(rec.value.get("user_id", ""))
-            self.broker.produce(T.PREDICTIONS, res, key=uid)
+            self.broker.produce(cfg.predictions_topic, res, key=uid)
             if res["fraud_score"] > cfg.alert_threshold:
-                self.broker.produce(T.ALERTS, self._to_alert(rec.value, res), key=uid)
+                self.broker.produce(cfg.alerts_topic,
+                                    self._to_alert(rec.value, res), key=uid)
                 self.counters["alerts"] += 1
             if cfg.emit_enriched or self.analytics is not None:
                 enriched = dict(rec.value)
@@ -347,7 +356,8 @@ class StreamJob:
                         ensemble_score=res["fraud_score"],
                     )
                 if cfg.emit_enriched:
-                    self.broker.produce(T.ENRICHED, enriched, key=uid)
+                    self.broker.produce(cfg.enriched_topic, enriched,
+                                        key=uid)
                 if self.analytics is not None:
                     self.analytics.process(
                         enriched, _event_time_ms(enriched, now) / 1000.0)
@@ -355,7 +365,7 @@ class StreamJob:
             # never ran assemble, so there are no feature rows for the batch)
             if cfg.emit_features and scored_ok:
                 self.broker.produce(
-                    T.FEATURES,
+                    cfg.features_topic,
                     {"transaction_id": res["transaction_id"],
                      "features": feats[i].tolist()},
                     key=uid,

@@ -259,6 +259,12 @@ class Config:
             _env("FRAUD_THRESHOLD", str(self.ensemble.fraud_threshold))
         )
         self.monitoring.log_level = _env("LOG_LEVEL", self.monitoring.log_level)
+        # the reference's Redis env contract (config.py REDIS_HOST/PORT):
+        # with state.backend="redis" these select the shared state plane
+        self.state.backend = _env("RTFD_STATE_BACKEND", self.state.backend)
+        self.state.redis_host = _env("REDIS_HOST", self.state.redis_host)
+        self.state.redis_port = int(
+            _env("REDIS_PORT", str(self.state.redis_port)))
 
     # -- registry helpers (reference config.py:201-224) --------------------
     def get_model_config(self, model_name: str) -> ModelConfig:
@@ -327,9 +333,20 @@ class Config:
 
 
 def _merge_dataclass(obj: Any, data: Dict[str, Any]) -> None:
-    """Recursively overlay a dict onto a dataclass tree."""
+    """Recursively overlay a dict onto a dataclass tree.
+
+    Unknown keys WARN instead of silently vanishing: a typo'd or renamed
+    knob in a config file must not quietly leave the default in force
+    (e.g. a stale cache-TTL key silently serving cached fraud verdicts 10x
+    longer than the operator configured).
+    """
+    import logging
+
     for key, value in data.items():
         if not hasattr(obj, key):
+            logging.getLogger(__name__).warning(
+                "config: unknown key %r on %s — ignored (typo or renamed "
+                "knob?)", key, type(obj).__name__)
             continue
         current = getattr(obj, key)
         if dataclasses.is_dataclass(current) and isinstance(value, dict):

@@ -454,3 +454,36 @@ def test_aof_rewrite_under_concurrent_writes(tmp_path):
     finally:
         c2.close()
         s2.stop()
+
+
+def test_scorer_connects_shared_tier_from_config_env():
+    """state.backend="redis" + REDIS_HOST/REDIS_PORT (the reference's env
+    contract) routes the scorer's state plane to the shared tier with no
+    explicit client; close() releases the owned connection."""
+    import os
+    from unittest import mock
+
+    from realtime_fraud_detection_tpu.scoring import FraudScorer
+    from realtime_fraud_detection_tpu.sim.simulator import TransactionGenerator
+    from realtime_fraud_detection_tpu.state.shared import SharedProfileStore
+    from realtime_fraud_detection_tpu.utils.config import Config
+
+    s = MiniRedisServer().start()
+    try:
+        with mock.patch.dict(os.environ, {
+                "RTFD_STATE_BACKEND": "redis",
+                "REDIS_HOST": "127.0.0.1",
+                "REDIS_PORT": str(s.port)}):
+            cfg = Config()
+        assert cfg.state.backend == "redis"
+        gen = TransactionGenerator(num_users=12, num_merchants=6, seed=8)
+        scorer = FraudScorer(config=cfg)
+        assert isinstance(scorer.profiles, SharedProfileStore)
+        assert scorer._owned_state_client is not None
+        scorer.seed_profiles(gen.users.profiles(), gen.merchants.profiles())
+        res = scorer.score_batch(gen.generate_batch(4))
+        assert len(res) == 4
+        scorer.close()
+        assert scorer._owned_state_client is None
+    finally:
+        s.stop()

@@ -396,3 +396,22 @@ def test_poisoned_record_degrades_alone_not_the_batch():
                    if k != str(records[3]["transaction_id"])]
     assert all(v["risk_level"] != "ERROR" for v in good_scores)
     assert broker.lag(job.config.group_id, T.TRANSACTIONS) == 0
+
+
+def test_job_topics_configurable_default_contract():
+    """Topic names flow from JobConfig (reference JobConfig.java topic
+    params); defaults are the §2.5 contract. A renamed predictions topic
+    receives the results; the contract topic stays silent."""
+    gen = TransactionGenerator(num_users=10, num_merchants=5, seed=41)
+    broker = InMemoryBroker()
+    scorer = FraudScorer(scorer_config=ScorerConfig(text_len=32))
+    scorer.seed_profiles(gen.users.profiles(), gen.merchants.profiles())
+    job = StreamJob(broker, scorer, JobConfig(
+        max_batch=8, transactions_topic="shadow-txns",
+        predictions_topic="shadow-preds", emit_features=False,
+        emit_enriched=False))
+    broker.produce_batch("shadow-txns", gen.generate_batch(8),
+                         key_fn=lambda r: str(r["user_id"]))
+    assert job.run_until_drained(now=1000.0) == 8
+    assert len(broker.consumer(["shadow-preds"], "c").poll(100)) == 8
+    assert broker.consumer([T.PREDICTIONS], "c2").poll(100) == []
