@@ -100,9 +100,12 @@ class _BatchCtx:
 class StreamJob:
     """Consume → score → fan out → commit. One instance per process.
 
-    The run loops are two-deep pipelined: while the device computes batch N,
-    the host polls + assembles + dispatches batch N+1, then completes batch
-    N (fan-out + offset commit, always in dispatch order).
+    The run loops keep up to ``JobConfig.pipeline_depth`` microbatches in
+    flight: while the device computes batch N, the host polls + assembles +
+    dispatches later batches, completing (fan-out + offset commit) strictly
+    in dispatch order. Depth 2 overlaps host work with device compute;
+    depth 3 additionally overlaps the result transfer with a full batch
+    period (see JobConfig.pipeline_depth for the staleness tradeoff).
     """
 
     def __init__(
