@@ -1,5 +1,6 @@
 """``python -m realtime_fraud_detection_tpu`` entry point."""
 
-from realtime_fraud_detection_tpu.cli import main
+from realtime_fraud_detection_tpu.cli import configure_process_logging, main
 
+configure_process_logging()
 raise SystemExit(main())

@@ -658,10 +658,41 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def configure_process_logging() -> None:
+    """Structured logging for a CLI-launched process (reference
+    logging_config.py is imported by each service entry point): LOG_LEVEL /
+    LOG_FILE via the config env layer; with a log file, every JSON line is
+    stamped with service_name. Called from the real process entry points
+    only — library callers (and tests) keep their own logging config.
+    Never fatal: a bad LOG_LEVEL must not take down --help."""
+    import logging
+
+    try:
+        from realtime_fraud_detection_tpu.obs.logs import setup_logging
+        from realtime_fraud_detection_tpu.utils.config import Config
+
+        cfg = Config()
+        setup_logging(level=cfg.monitoring.log_level,
+                      json_file=cfg.monitoring.log_file or None,
+                      service_name=cfg.service_name)
+    except Exception as e:  # noqa: BLE001 — fall back, don't crash the CLI
+        logging.basicConfig(level=logging.INFO)
+        logging.getLogger(__name__).warning(
+            "logging setup failed (%s); using basicConfig", e)
+
+
+def entrypoint() -> int:
+    """Console-script entry (pyproject [project.scripts]): identical
+    behavior to ``python -m realtime_fraud_detection_tpu``."""
+    configure_process_logging()
+    return main()
+
+
 def main(argv: Optional[list[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     return args.fn(args)
 
 
 if __name__ == "__main__":
+    configure_process_logging()
     raise SystemExit(main())

@@ -31,6 +31,12 @@ _RESERVED = set(logging.LogRecord(
 class JsonFormatter(logging.Formatter):
     """One JSON object per line; extra record attrs become fields."""
 
+    def __init__(self, service_name: str = ""):
+        super().__init__()
+        # config.service_name (reference logging_config.py service field):
+        # lets one log pipeline multiplex scorer/stream-job/state-server
+        self.service_name = service_name
+
     def format(self, record: logging.LogRecord) -> str:
         out: Dict[str, Any] = {
             "ts": round(record.created, 6),
@@ -38,6 +44,8 @@ class JsonFormatter(logging.Formatter):
             "logger": record.name,
             "message": record.getMessage(),
         }
+        if self.service_name:
+            out["service"] = self.service_name
         for k, v in record.__dict__.items():
             if k not in _RESERVED and not k.startswith("_"):
                 out[k] = v
@@ -47,8 +55,10 @@ class JsonFormatter(logging.Formatter):
 
 
 def setup_logging(level: str = "INFO", json_file: Optional[str] = None,
-                  max_bytes: int = 10 * 1024 * 1024, backups: int = 3) -> None:
-    """Configure root logging (reference logging_config.py:11-93)."""
+                  max_bytes: int = 10 * 1024 * 1024, backups: int = 3,
+                  service_name: str = "") -> None:
+    """Configure root logging (reference logging_config.py:11-93).
+    ``service_name`` stamps every JSON line (config.service_name)."""
     handlers: Dict[str, Any] = {
         "console": {
             "class": "logging.StreamHandler",
@@ -72,7 +82,8 @@ def setup_logging(level: str = "INFO", json_file: Optional[str] = None,
             "console": {
                 "format": "%(asctime)s %(levelname)-7s %(name)s  %(message)s",
             },
-            "json": {"()": f"{__name__}.JsonFormatter"},
+            "json": {"()": f"{__name__}.JsonFormatter",
+                     "service_name": service_name},
         },
         "handlers": handlers,
         "root": {"level": level, "handlers": list(handlers)},

@@ -74,6 +74,17 @@ class ServingApp:
         )
         self.http = HttpServer(host if host is not None else sc.host,
                                port if port is not None else sc.port)
+        # dedicated Prometheus port (reference monitoring contract: metrics
+        # on 8081 separate from the API; config.monitoring.enable_prometheus
+        # + prometheus_port). 0 disables the extra listener — the main app
+        # still serves /metrics/prometheus for annotation-based scraping.
+        self.metrics_http: Optional[HttpServer] = None
+        mon = self.config.monitoring
+        if mon.enable_prometheus and mon.prometheus_port:
+            self.metrics_http = HttpServer(
+                host if host is not None else sc.host, mon.prometheus_port)
+            self.metrics_http.route("GET", "/metrics",
+                                    self._metrics_prometheus)
         self._reload_lock = asyncio.Lock()
         # prediction TTL cache (reference ensemble_predictor.py:437-471):
         # idempotent retries of a transaction_id serve the stored response
@@ -141,11 +152,12 @@ class ServingApp:
         # re-recording it would feed correlated duplicate observations into
         # the A/B significance test and inflate decision metrics
         self._apply_experiments(to_score, fresh)
-        per_txn = dt / max(len(fresh), 1)
-        for r in fresh:
-            self.metrics.record_prediction(
-                r["decision"], r["fraud_score"], per_txn,
-                r["model_predictions"])
+        if self.config.monitoring.enable_performance_tracking:
+            per_txn = dt / max(len(fresh), 1)
+            for r in fresh:
+                self.metrics.record_prediction(
+                    r["decision"], r["fraud_score"], per_txn,
+                    r["model_predictions"])
         if cache is not None:
             # cache AFTER experiments: the stored response is exactly what
             # this request serves, so a retry is truly idempotent even when
@@ -402,8 +414,12 @@ class ServingApp:
     async def start(self) -> None:
         await self.batcher.start()
         await self.http.start()
+        if self.metrics_http is not None:
+            await self.metrics_http.start()
 
     async def stop(self) -> None:
+        if self.metrics_http is not None:
+            await self.metrics_http.stop()
         await self.http.stop()
         await self.batcher.stop()
 

@@ -133,6 +133,9 @@ class MonitoringConfig:
     enable_prometheus: bool = True
     prometheus_port: int = 8081
     log_level: str = "INFO"
+    # rotating JSON log file (reference logging_config.py file handler);
+    # empty = console only. The service_name stamp rides the JSON lines.
+    log_file: str = ""
     enable_performance_tracking: bool = True
     enable_drift_detection: bool = True
 
@@ -259,6 +262,7 @@ class Config:
             _env("FRAUD_THRESHOLD", str(self.ensemble.fraud_threshold))
         )
         self.monitoring.log_level = _env("LOG_LEVEL", self.monitoring.log_level)
+        self.monitoring.log_file = _env("LOG_FILE", self.monitoring.log_file)
         # the reference's Redis env contract (config.py REDIS_HOST/PORT):
         # with state.backend="redis" these select the shared state plane
         self.state.backend = _env("RTFD_STATE_BACKEND", self.state.backend)

@@ -155,6 +155,9 @@ def app_server():
     # each new batch bucket compiles once (~tens of seconds on the CPU test
     # backend); the timeout must cover compilation, not just steady state
     config.serving.prediction_timeout_seconds = 180.0
+    # no fixed-port metrics listener in the shared fixture (8081 could
+    # collide across test runs); the dedicated-port behavior has its own test
+    config.monitoring.prometheus_port = 0
     app = ServingApp(config, host="127.0.0.1", port=0)
     gen = TransactionGenerator(num_users=128, num_merchants=32)
     app.scorer.seed_profiles(gen.users.profiles(), gen.merchants.profiles())
@@ -258,6 +261,37 @@ class TestEndpoints:
             assert app._inflight_txns == 0
         finally:
             app.config.serving.max_concurrent_predictions = limit_before
+
+    def test_dedicated_prometheus_port(self):
+        """config.monitoring.prometheus_port runs a second listener serving
+        GET /metrics in Prometheus text (reference: metrics on 8081
+        separate from the API)."""
+        import asyncio
+        import socket
+
+        from realtime_fraud_detection_tpu.serving import ServingApp
+        from realtime_fraud_detection_tpu.utils.config import Config
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            free_port = s.getsockname()[1]
+        config = Config()
+        config.monitoring.prometheus_port = free_port
+        app = ServingApp(config, host="127.0.0.1", port=0)
+        assert app.metrics_http is not None
+
+        async def main():
+            await app.start()
+            try:
+                # _request blocks; run it off-loop so the server can answer
+                return await asyncio.to_thread(
+                    _request, free_port, "GET", "/metrics")
+            finally:
+                await app.stop()
+
+        status, text = asyncio.run(main())
+        assert status == 200
+        assert "rtfd" in str(text) or "predictions" in str(text)
 
     def test_prediction_cache_unit_ttl_and_eviction(self):
         from realtime_fraud_detection_tpu.serving.cache import PredictionCache
@@ -447,6 +481,7 @@ def test_serving_app_on_shared_state_tier():
     state = MiniRedisServer().start()
     config = Config()
     config.serving.prediction_timeout_seconds = 180.0
+    config.monitoring.prometheus_port = 0   # no fixed-port listener in tests
     scorer = FraudScorer(config, scorer_config=ScorerConfig(text_len=32),
                          state_client=RespClient(port=state.port))
     app = ServingApp(config, host="127.0.0.1", port=0, scorer=scorer)
