@@ -41,6 +41,10 @@ class ModelConfig:
     model_type: str  # 'gbdt' | 'lstm' | 'bert' | 'gnn' | 'isolation_forest'
     weight: float = 1.0
     enabled: bool = True
+    # reference parity field (config.py:13 per-model artifact path). Unused
+    # by design here: all five branches live in ONE orbax checkpoint
+    # (checkpoint.py) addressed by directory+step, not per-model files —
+    # per-branch swaps go through set_models/per-branch validity instead.
     model_path: str = ""
     hyperparameters: Dict[str, Any] = field(default_factory=dict)
 
