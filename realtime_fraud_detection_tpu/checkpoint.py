@@ -277,6 +277,24 @@ class CheckpointManager:
                 scorer.set_models(ck.params)
             if ck.host_state is not None:
                 restore_scorer_host_state(scorer, ck.host_state)
+            # re-attach the trainer's gain importances (set_models cleared
+            # them — they describe exactly the restored trees). Host-state
+            # restore above already covers checkpoints that snapshot the
+            # scorer; this covers params-only train checkpoints.
+            imp = (ck.metadata or {}).get("feature_importances")
+            if imp is not None and scorer._top_importances is None:
+                try:
+                    scorer.set_feature_importances(imp)
+                except (ValueError, TypeError) as e:
+                    import logging
+
+                    # lenient (old/foreign manifest) but never silent: the
+                    # operator must be able to see why explanations lack
+                    # top_feature_importances
+                    logging.getLogger(__name__).warning(
+                        "checkpoint step %s: feature_importances in "
+                        "manifest not attachable (%s); explanations will "
+                        "omit top_feature_importances", step, e)
         return ck
 
     def restore(self, step: Optional[int] = None,
@@ -333,6 +351,10 @@ def snapshot_scorer_host_state(scorer) -> Dict[str, Any]:
         "users_index": scorer._users,
         "merchants_index": scorer._merchants,
         "stats": dict(scorer.stats),
+        # the top-10 explanation importances are scorer host state too —
+        # every save/restore path round-trips them, not just the train CLI's
+        # metadata (set_models during restore clears them deliberately)
+        "top_importances": scorer._top_importances,
     }
 
 
@@ -345,3 +367,5 @@ def restore_scorer_host_state(scorer, state: Mapping[str, Any]) -> None:
     scorer._users = state["users_index"]
     scorer._merchants = state["merchants_index"]
     scorer.stats.update(state["stats"])
+    if state.get("top_importances") is not None:
+        scorer._top_importances = dict(state["top_importances"])

@@ -321,7 +321,12 @@ def cmd_train(args: argparse.Namespace) -> int:
                               "fraud_rate": float(y.mean()),
                               "sim_seed": args.seed,
                               "sim_users": args.users,
-                              "sim_merchants": args.merchants})
+                              "sim_merchants": args.merchants,
+                              # restored by restore_into_scorer so served
+                              # explanations keep their importances
+                              "feature_importances":
+                                  [round(float(v), 6) for v in
+                                   gbdt_trainer.feature_importances_]})
     from realtime_fraud_detection_tpu.features.extract import (
         top_feature_importances,
     )

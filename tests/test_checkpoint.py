@@ -197,3 +197,24 @@ def test_checkpoint_offsets_from_group_managed_consumer(tmp_path):
     finally:
         b.close()
         server.stop()
+
+
+def test_restore_reattaches_feature_importances(tmp_path):
+    """train -> restore_into_scorer: served explanations keep the
+    trainer's gain importances (set_models alone clears them)."""
+    from realtime_fraud_detection_tpu.checkpoint import CheckpointManager
+    from realtime_fraud_detection_tpu.cli import main
+    from realtime_fraud_detection_tpu.scoring import FraudScorer
+    from realtime_fraud_detection_tpu.sim.simulator import TransactionGenerator
+
+    assert main(["train", "--rows", "1500", "--trees", "8",
+                 "--users", "200", "--merchants", "40",
+                 "--out", str(tmp_path / "ck")]) == 0
+    scorer = FraudScorer(seed=1)
+    CheckpointManager(str(tmp_path / "ck")).restore_into_scorer(scorer)
+    gen = TransactionGenerator(num_users=200, num_merchants=40, seed=9)
+    scorer.seed_profiles(gen.users.profiles(), gen.merchants.profiles())
+    res = scorer.score_batch(gen.generate_batch(4))
+    top = res[0]["explanation"].get("top_feature_importances")
+    assert top and len(top) <= 10
+    assert all(v > 0 for v in top.values())
