@@ -99,6 +99,15 @@ def pack_tree(tree: Any) -> Tuple[Dict[str, np.ndarray], PackSpec]:
                 f"pack_tree: every leaf needs leading dim {b}, "
                 f"got shape {arr.shape}")
         blob, cast = _blob_for(arr.dtype)
+        if (blob == "i32" and arr.dtype.itemsize > 4 and arr.size
+                and (arr.max() > np.iinfo(np.int32).max
+                     or arr.min() < np.iinfo(np.int32).min)):
+            # fail loudly rather than silently wrapping (e.g. a future
+            # epoch-ms int64 field would otherwise corrupt features)
+            raise ValueError(
+                f"pack_tree: {arr.dtype} leaf exceeds int32 range "
+                f"(min={arr.min()}, max={arr.max()}); the ScoreBatch "
+                f"contract requires ints to fit in int32")
         tail = arr.shape[1:]
         width = int(math.prod(tail))
         parts[blob].append(

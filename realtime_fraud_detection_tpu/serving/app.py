@@ -143,7 +143,12 @@ class ServingApp:
             self.metrics.record_error("score")
             raise
         dt = time.perf_counter() - t0
-        self.metrics.record_batch(len(txns), dt)
+        # batch metrics count the same population as per-prediction metrics:
+        # fresh results only — a cache hit costs ~0 and would deflate the
+        # apparent batch latency per txn; an all-hit batch records nothing
+        # (no device batch happened)
+        if fresh:
+            self.metrics.record_batch(len(fresh), dt)
         if self.config.monitoring.enable_drift_detection and pending is not None:
             with self._score_lock:
                 self.drift.update(pending.features)
@@ -307,6 +312,9 @@ class ServingApp:
             "queue_depth": self.batcher.queue_depth,
         }
         if self.prediction_cache is not None:
+            # lock-free by contract (cache.py): stats() reads only atomic
+            # counters, and taking _score_lock here would stall the event
+            # loop behind an executor thread's batch assembly
             payload["prediction_cache"] = self.prediction_cache.stats()
         return 200, payload
 

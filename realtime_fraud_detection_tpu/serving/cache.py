@@ -8,8 +8,13 @@ trip. Scoring is stateful (velocity/history move on), so the cache exists
 for idempotent retries, not memoization — the TTL bounds how stale a
 served-again response can be.
 
-Single-writer like the rest of the serving host state: callers hold the
-serving score lock.
+Single-writer like the rest of the serving host state: MUTATING calls
+(get/put/clear) happen under the serving score lock. ``stats()`` is the
+one exception — it only reads int counters and len(), each an atomic read
+under the GIL, so /health may call it lock-free from the event loop (a
+momentarily torn hits/entries pair is fine for a monitoring endpoint;
+blocking the event loop on the score lock, held across batch assembly,
+would not be).
 """
 
 from __future__ import annotations

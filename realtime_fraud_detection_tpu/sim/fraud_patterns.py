@@ -51,34 +51,34 @@ def _scenarios() -> Dict[str, FraudScenario]:
     S = FraudScenario
     return {
         "card_testing": S("Card Testing",
-                          "Testing stolen card numbers with small transactions",
+                          "Probing stolen card credentials via tiny purchases",
                           0.025, "medium", "easy", (0.99, 9.99), "burst", "random"),
         "account_takeover": S("Account Takeover",
-                              "Legitimate account compromised by fraudster",
+                              "Genuine account hijacked by an attacker",
                               0.015, "high", "medium", (100.0, 2000.0), "sustained", "remote"),
         "synthetic_identity": S("Synthetic Identity Fraud",
-                                "Fake identity created with real and fake information",
+                                "Fabricated identity blending genuine and invented data",
                                 0.008, "high", "hard", (500.0, 5000.0), "sustained", "local"),
         "first_party_fraud": S("First Party Fraud",
-                               "Legitimate customer committing fraud",
+                               "Account owner abusing their own account",
                                0.012, "medium", "very_hard", (200.0, 1500.0), "single", "local"),
         "money_laundering": S("Money Laundering",
-                              "Structured transactions to hide money source",
+                              "Deposits split just under reporting limits to obscure origin",
                               0.005, "critical", "hard", (9000.0, 9900.0), "sustained", "random"),
         "merchant_fraud": S("Merchant Fraud",
-                            "Fraudulent merchant processing fake transactions",
+                            "Complicit merchant running fabricated charges",
                             0.003, "high", "medium", (50.0, 500.0), "sustained", "local"),
         "velocity_fraud": S("Velocity Fraud",
-                            "Rapid succession of transactions exceeding normal patterns",
+                            "Burst of charges far above the account's usual cadence",
                             0.018, "medium", "easy", (25.0, 300.0), "burst", "local"),
         "geographic_fraud": S("Geographic Impossibility",
-                              "Transactions in impossible geographic sequence",
+                              "Charges from locations no traveler could reach in time",
                               0.010, "medium", "medium", (100.0, 800.0), "single", "international"),
         "bust_out_fraud": S("Bust-Out Fraud",
-                            "Building credit profile then maxing out quickly",
+                            "Patiently grown credit line drained in one spree",
                             0.004, "high", "hard", (1000.0, 8000.0), "burst", "local"),
         "friendly_fraud": S("Friendly Fraud",
-                            "Legitimate customer disputing valid charges",
+                            "Cardholder charging back purchases they actually made",
                             0.020, "low", "very_hard", (50.0, 1000.0), "single", "local"),
     }
 
@@ -111,7 +111,7 @@ class AdvancedFraudPatterns:
         applier = getattr(self, f"_apply_{fraud_type}", None)
         if applier is None:
             txn["fraud_score"] = float(self.rng.uniform(0.50, 0.80))
-            txn["fraud_reason"] = f"Unknown fraud pattern: {fraud_type}"
+            txn["fraud_reason"] = f"Unrecognized scenario key: {fraud_type}"
             return txn
         return applier(txn)
 
@@ -123,7 +123,7 @@ class AdvancedFraudPatterns:
         txn["amount"] = self._amount("card_testing")
         txn["card_last_four"] = str(self.rng.choice(["1234", "5678", "9999", "0000"]))
         txn["fraud_score"] = float(self.rng.uniform(0.75, 0.95))
-        txn["fraud_reason"] = "Small amount testing pattern detected"
+        txn["fraud_reason"] = "Card-testing probe: repeated tiny charges"
         txn["ip_address"] = _random_public_ip(self.rng)
         return txn
 
@@ -141,7 +141,7 @@ class AdvancedFraudPatterns:
         txn["device_id"] = txn["device_fingerprint"]
         txn["amount"] = self._amount("account_takeover")
         txn["fraud_score"] = float(self.rng.uniform(0.70, 0.90))
-        txn["fraud_reason"] = "Geographic and device anomaly detected"
+        txn["fraud_reason"] = "Login from unfamiliar device and distant location"
         return txn
 
     def _apply_velocity_fraud(self, txn):
@@ -154,17 +154,17 @@ class AdvancedFraudPatterns:
         count = len(window)
         if count > 5:
             txn["fraud_score"] = min(0.95, 0.5 + count * 0.1)
-            txn["fraud_reason"] = f"High velocity: {count} transactions in 10 minutes"
+            txn["fraud_reason"] = f"Burst rate: {count} charges inside a 10-minute window"
         else:
             txn["fraud_score"] = float(self.rng.uniform(0.60, 0.80))
-            txn["fraud_reason"] = "Velocity pattern detected"
+            txn["fraud_reason"] = "Charge cadence far above account baseline"
         txn["amount"] = self._amount("velocity_fraud")
         return txn
 
     def _apply_synthetic_identity(self, txn):
         txn["amount"] = self._amount("synthetic_identity")
         txn["fraud_score"] = float(self.rng.uniform(0.65, 0.85))
-        txn["fraud_reason"] = "Synthetic identity pattern indicators"
+        txn["fraud_reason"] = "Profile signals consistent with a fabricated identity"
         txn["transaction_type"] = "purchase"
         return txn
 
@@ -174,7 +174,7 @@ class AdvancedFraudPatterns:
     def _apply_money_laundering(self, txn):
         txn["amount"] = self._amount("money_laundering")  # structuring 9000-9900
         txn["fraud_score"] = float(self.rng.uniform(0.70, 0.90))
-        txn["fraud_reason"] = "Structured transaction pattern"
+        txn["fraud_reason"] = "Amounts structured under the reporting threshold"
         return txn
 
     def _apply_geographic_fraud(self, txn):
@@ -186,31 +186,31 @@ class AdvancedFraudPatterns:
             }
         txn["amount"] = self._amount("geographic_fraud")
         txn["fraud_score"] = float(self.rng.uniform(0.75, 0.90))
-        txn["fraud_reason"] = "Geographic impossibility detected"
+        txn["fraud_reason"] = "Location sequence physically impossible to travel"
         return txn
 
     def _apply_merchant_fraud(self, txn):
         txn["amount"] = float(self.rng.choice([49.99, 99.99, 199.99, 299.99]))
         txn["fraud_score"] = float(self.rng.uniform(0.60, 0.85))
-        txn["fraud_reason"] = "Merchant fraud pattern detected"
+        txn["fraud_reason"] = "Merchant-side fabricated charge signature"
         return txn
 
     def _apply_bust_out_fraud(self, txn):
         txn["amount"] = self._amount("bust_out_fraud")
         txn["fraud_score"] = float(self.rng.uniform(0.70, 0.90))
-        txn["fraud_reason"] = "Bust-out spending pattern"
+        txn["fraud_reason"] = "Credit line drained in a bust-out spree"
         return txn
 
     def _apply_friendly_fraud(self, txn):
         txn["amount"] = self._amount("friendly_fraud")
         txn["fraud_score"] = float(self.rng.uniform(0.05, 0.25))
-        txn["fraud_reason"] = "Potential friendly fraud"
+        txn["fraud_reason"] = "Chargeback risk on a likely-genuine purchase"
         return txn
 
     def _apply_first_party_fraud(self, txn):
         txn["amount"] = self._amount("first_party_fraud")
         txn["fraud_score"] = float(self.rng.uniform(0.10, 0.40))
-        txn["fraud_reason"] = "First party fraud indicators"
+        txn["fraud_reason"] = "Owner-abuse signals on the account itself"
         return txn
 
     def record_location(self, user_id: str, geo: Dict[str, float]) -> None:

@@ -26,6 +26,12 @@ import threading
 import time
 from typing import Any, Dict, List, Mapping, Optional
 
+from realtime_fraud_detection_tpu.utils.config import (
+    DECLINE_THRESHOLD_DEFAULT,
+    MONITOR_THRESHOLD_DEFAULT,
+    REVIEW_THRESHOLD_DEFAULT,
+)
+
 __all__ = ["Variant", "VariantStats", "Experiment", "ABTestManager",
            "apply_weight_overrides"]
 
@@ -35,9 +41,9 @@ def apply_weight_overrides(
         base_weights: Mapping[str, float],
         overrides: Mapping[str, float],
         confidence_threshold: float = 0.7,
-        decline_threshold: float = 0.95,
-        review_threshold: float = 0.8,
-        monitor_threshold: float = 0.6) -> Optional[Dict[str, Any]]:
+        decline_threshold: float = DECLINE_THRESHOLD_DEFAULT,
+        review_threshold: float = REVIEW_THRESHOLD_DEFAULT,
+        monitor_threshold: float = MONITOR_THRESHOLD_DEFAULT) -> Optional[Dict[str, Any]]:
     """Re-combine per-model predictions under variant weight overrides.
 
     The fused scorer returns every branch's prediction, so a variant that
