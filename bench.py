@@ -2,15 +2,28 @@
 
 Prints ONE JSON line and ALWAYS exits 0 — even when the TPU relay is wedged.
 
-Architecture (VERDICT r2 item 1): the parent process is a jax-free
-orchestrator. It probes TPU availability in a short-timeout subprocess
-(backend init on this host can HANG, not just raise — the axon PJRT plugin
-wedges inside ``jax.devices()``), then runs the actual bench as
-``bench.py --inner`` in a child. If the TPU probe or the TPU bench fails or
-times out, it re-runs the child on a clean CPU backend (``PALLAS_AXON_POOL_IPS``
-removed so the sitecustomize TPU registration never happens,
-``JAX_PLATFORMS=cpu``) and still emits the one JSON line, with
-``"device": "cpu-fallback"`` and an ``"error"`` field naming the TPU failure.
+Budget contract (VERDICT r4 item 1): the WHOLE script fits in
+``RTFD_BENCH_BUDGET_S`` (default 840 s ≈ 14 min) wall-clock, and a valid
+JSON line lands on stdout no matter what:
+
+- TPU probing is capped at 2 × 90 s attempts with a short gap (~3.5 min
+  worst case), then the orchestrator moves on immediately.
+- The inner bench receives the global deadline via env and writes a JSON
+  snapshot to a side file after EVERY completed stage; stages are ordered
+  headline-first so an early kill still leaves the 5 BASELINE configs.
+- The parent keeps the best-known result in memory and installs
+  SIGTERM/SIGALRM handlers that kill the child, print that JSON, and exit 0
+  — an external timeout can never leave ``parsed: null`` again.
+- If the TPU run dies or times out, its latest snapshot is recovered; a CPU
+  fallback (clean backend, relay never touched) fills any configs the TPU
+  partial is missing.
+
+Architecture: the parent process is a jax-free orchestrator. It probes TPU
+availability in a short-timeout subprocess (backend init on this host can
+HANG, not just raise — the axon PJRT plugin wedges inside ``jax.devices()``),
+then runs the actual bench as ``bench.py --inner`` in a child. CPU fallback
+runs with ``PALLAS_AXON_POOL_IPS`` removed so the sitecustomize TPU
+registration never happens.
 
 Headline metric: full-ensemble scoring throughput (transactions/sec/chip,
 batch=256, pipelined dispatch — how the production StreamJob /
@@ -23,6 +36,13 @@ Also reported:
   XGB batch=1, XGB+IsolationForest µbatch=32, BERT encoder, LSTM,
   GraphSAGE + full ensemble (the reference's unbatched hot path analog is
   main.py:235-248, which loops batch=1).
+- ``bucket_sweep``: the p99<20 ms operating-point table (VERDICT r4
+  item 3) — per microbatch bucket {32, 64, 128, 256}: blocked-call
+  p50/p99, the same net of the measured tunnel null RTT, the pipelined
+  batch period, and sustained txn/s; ``passing`` names every bucket whose
+  p99 net of transport meets the 20 ms budget. This is the measurement the
+  reference's never-exercised TF-Serving batching config implies
+  (k8s/manifests/ml-models-deployment.yaml:270-290).
 - ``latency``: p50/p99 per batch size for the full ensemble, measured two
   ways: ``e2e`` (host-resident args, includes H2D + dispatch round-trip —
   what a caller over the axon tunnel sees) and ``device`` (device-resident
@@ -30,25 +50,31 @@ Also reported:
 - ``pallas``: DistilBERT-base branch with the Pallas flash-attention kernel
   vs plain XLA attention on this chip; the faster one is used for the
   headline ensemble program.
-- ``mfu``: analytic matmul FLOPs of the fused batch=256 ensemble program
-  (BERT + LSTM + GNN; tree gathers contribute ~0 FLOPs) divided by
-  device-resident p50 time and the chip's bf16 peak (VERDICT r2 item 8).
+- ``mfu``: throughput-derived (batch / pipelined txn_per_s — no dispatch or
+  cache artifact can inflate it) over analytic matmul FLOPs of ALL branches
+  (BERT + LSTM + GNN matmuls; tree/iforest branches are gather/compare
+  programs whose matmul FLOPs are genuinely ~0, recorded as such). An
+  implausible value (outside (0, 1)) is REFUSED and reported as an error
+  instead of a number (VERDICT r4 item 4).
 - ``e2e_stream``: StreamJob soak over the in-memory broker (assemble +
-  device + fan-out + commit, two-deep pipelined) — the whole-framework
-  number, not just the device program.
+  device + fan-out + commit, pipelined) — the whole-framework number, not
+  just the device program.
 
 Timing discipline (axon tunnel): everything is measured with
 ``block_until_ready`` BEFORE any device->host result pull — the first
 transfer drops the tunnel into synchronous mode and would poison later
-configs.
+configs. See utils/timing.py.
 """
 
 from __future__ import annotations
 
+import glob
 import json
 import os
+import signal
 import subprocess
 import sys
+import tempfile
 import time
 
 import numpy as np
@@ -60,6 +86,12 @@ METRIC_NAME = (
     "full-ensemble scoring throughput "
     "(5 branches, batch=256, text seq 64, pipelined)"
 )
+TOTAL_BUDGET_S = float(os.environ.get("RTFD_BENCH_BUDGET_S", "840"))
+# reserved for the CPU fallback when the TPU path fails outright
+CPU_RESERVE_S = 240.0
+# the 5 BASELINE.json configs the driver's JSON must always contain
+REQUIRED_CONFIGS = ("xgboost_batch1", "xgb_iforest_mb32", "bert_encoder",
+                    "lstm_seq", "graphsage_full_ensemble")
 # Per-chip bf16 peak for MFU accounting, by platform substring. Checked
 # in order: the r1 chip printed as "TPU v5 lite0" (neither "v5e" nor
 # "v5p"), so the lite spellings must come first (VERDICT r3 weak-6).
@@ -79,6 +111,44 @@ def _log(msg: str) -> None:
 # --------------------------------------------------------------------------
 # Orchestrator (jax-free: must never initialize a backend in this process)
 # --------------------------------------------------------------------------
+
+_BEST: dict = {"metric": METRIC_NAME, "value": 0.0, "unit": "txn/s/chip",
+               "vs_baseline": 0.0, "device": "none",
+               "error": "no stage completed"}
+_CHILD = None          # active inner-bench Popen, killed by the emergency path
+_EMITTED = False
+
+
+def _emit_and_exit() -> None:
+    """Print the best-known JSON line exactly once and exit 0."""
+    global _EMITTED
+    if _EMITTED:
+        os._exit(0)
+    _EMITTED = True
+    try:
+        print(json.dumps(_BEST), flush=True)
+    finally:
+        os._exit(0)
+
+
+def _emergency(signum, frame) -> None:
+    _log(f"signal {signum}: emitting best-known result and exiting")
+    try:
+        if _CHILD is not None and _CHILD.poll() is None:
+            _CHILD.kill()
+    except Exception:
+        pass
+    _emit_and_exit()
+
+
+def _deadline() -> float:
+    """Absolute monotonic deadline for the whole script."""
+    return _T0 + TOTAL_BUDGET_S
+
+
+def _remaining() -> float:
+    return _deadline() - time.monotonic()
+
 
 def _probe_tpu_once(timeout_s: float) -> tuple[str | None, str | None]:
     """(platform, error): init the backend in a throwaway subprocess."""
@@ -100,11 +170,11 @@ def _probe_tpu_once(timeout_s: float) -> tuple[str | None, str | None]:
     return None, "probe produced no PLATFORM line"
 
 
-def _probe_tpu(attempts: int = 5, timeout_s: float = 150.0,
-               gap_s: float = 120.0) -> tuple[str | None, list[dict]]:
-    """Retry the TPU probe across ~the first 20 min of the bench window —
-    a transiently wedged relay must not silently cost the round its perf
-    story (VERDICT r3 weak-1). Returns (platform|None, attempt timeline)."""
+def _probe_tpu(attempts: int = 2, timeout_s: float = 90.0,
+               gap_s: float = 20.0) -> tuple[str | None, list[dict]]:
+    """Short, budget-bounded TPU probe: 2 × 90 s + one 20 s gap ≈ 3.5 min
+    worst case (VERDICT r4 item 1 capped this from r4's 5 × 150 s + gaps,
+    which alone could eat the driver's whole window)."""
     timeline: list[dict] = []
     for i in range(attempts):
         t0 = time.monotonic() - _T0
@@ -123,18 +193,50 @@ def _probe_tpu(attempts: int = 5, timeout_s: float = 150.0,
     return None, timeline
 
 
-def _run_inner(env: dict, timeout_s: float) -> dict:
-    """Run ``bench.py --inner``; return the parsed JSON result line.
+def _read_snapshot(path: str) -> dict | None:
+    try:
+        with open(path) as f:
+            snap = json.load(f)
+        return snap if isinstance(snap, dict) and "metric" in snap else None
+    except (OSError, ValueError):
+        return None
+
+
+def _run_inner(env: dict, timeout_s: float, snap_path: str) -> dict | None:
+    """Run ``bench.py --inner``; return its final JSON, or — if it dies or
+    times out — the latest per-stage snapshot it wrote (marked partial).
 
     stderr is inherited so per-stage progress streams to the driver log
     even if this parent is later killed.
     """
-    proc = subprocess.run(
+    global _CHILD
+    env = dict(env)
+    env["RTFD_BENCH_SNAPSHOT"] = snap_path
+    env["RTFD_BENCH_DEADLINE_UNIX"] = str(time.time() + timeout_s - 10.0)
+    _CHILD = subprocess.Popen(
         [sys.executable, os.path.abspath(__file__), "--inner"],
-        stdout=subprocess.PIPE, text=True, env=env, timeout=timeout_s,
+        stdout=subprocess.PIPE, text=True, env=env,
         cwd=os.path.dirname(os.path.abspath(__file__)),
     )
-    for line in reversed((proc.stdout or "").splitlines()):
+    try:
+        stdout, _ = _CHILD.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        _CHILD.kill()
+        try:
+            _CHILD.communicate(timeout=10.0)
+        except Exception:
+            pass
+        _log(f"inner bench timed out after {timeout_s:.0f}s; "
+             f"recovering last stage snapshot")
+        snap = _read_snapshot(snap_path)
+        if snap is not None:
+            snap["partial"] = True
+            snap.setdefault("error", "inner bench hit the time budget; "
+                                     "result is the last completed stage")
+        return snap
+    finally:
+        _CHILD = None
+    for line in reversed((stdout or "").splitlines()):
         line = line.strip()
         if line.startswith("{"):
             try:
@@ -143,7 +245,14 @@ def _run_inner(env: dict, timeout_s: float) -> dict:
                 continue
             if isinstance(parsed, dict) and "metric" in parsed:
                 return parsed
-    raise RuntimeError(f"inner bench rc={proc.returncode}, no JSON line")
+    _log(f"inner bench rc={_CHILD.returncode if _CHILD else '?'} produced no "
+         f"JSON line; recovering snapshot")
+    snap = _read_snapshot(snap_path)
+    if snap is not None:
+        snap["partial"] = True
+        snap.setdefault("error", "inner bench died; result is the last "
+                                 "completed stage snapshot")
+    return snap
 
 
 def _cpu_env() -> dict:
@@ -156,67 +265,123 @@ def _cpu_env() -> dict:
     return env
 
 
+def _attach_tpu_capture(result: dict) -> None:
+    """When the relay is down at bench time, surface the newest committed
+    on-chip capture so a wedged relay can't erase measured TPU performance."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    captures = sorted(glob.glob(os.path.join(here, "BENCH_r*_tpu_capture.json")))
+    if not captures:
+        return
+    try:
+        with open(captures[-1]) as f:
+            cap = json.load(f)
+        result["same_round_tpu_capture"] = {
+            "headline": cap.get("headline"),
+            "file": os.path.basename(captures[-1]),
+            "note": "committed during a live relay window; see capture_note "
+                    "inside the file for methodology, and MEASUREMENTS_r*"
+                    ".json for the instrumented soak/sweep data",
+        }
+    except (OSError, ValueError):
+        pass
+
+
 def orchestrate() -> None:
+    global _BEST
+    signal.signal(signal.SIGTERM, _emergency)
+    signal.signal(signal.SIGALRM, _emergency)
+    # hard internal alarm: even if everything below wedges, a JSON line
+    # lands before the driver's own timeout can produce rc=124/parsed:null
+    signal.alarm(int(TOTAL_BUDGET_S) + 20)
+
     errors: list[str] = []
     result: dict | None = None
+    snap_dir = tempfile.mkdtemp(prefix="rtfd_bench_")
 
     platform, timeline = _probe_tpu()
     if platform and platform != "cpu":
-        _log(f"TPU probe ok (platform={platform}); running bench on it")
-        try:
-            result = _run_inner(dict(os.environ), timeout_s=1800.0)
-        except Exception as e:  # noqa: BLE001 — must always emit JSON
-            errors.append(f"tpu bench failed: {type(e).__name__}: {e}"[:300])
-            _log(errors[-1])
+        budget = _remaining() - CPU_RESERVE_S
+        _log(f"TPU probe ok (platform={platform}); "
+             f"running bench on it (budget {budget:.0f}s)")
+        if budget > 60:
+            tpu_snap = os.path.join(snap_dir, "tpu.json")
+            try:
+                result = _run_inner(dict(os.environ), budget, tpu_snap)
+            except Exception as e:  # noqa: BLE001 — must always emit JSON
+                errors.append(f"tpu bench failed: {type(e).__name__}: {e}"[:300])
+                _log(errors[-1])
+            if result is not None:
+                _BEST = dict(result)
+        else:
+            errors.append("tpu probed ok but no budget left for the bench")
     else:
         errors.append(
             f"tpu unavailable after {len(timeline)} probe attempts "
             f"(last: {timeline[-1]['result'] if timeline else 'none'})")
         _log(errors[-1])
 
-    if result is None:
-        _log("falling back to clean CPU backend")
+    missing = [c for c in REQUIRED_CONFIGS
+               if c not in (result or {}).get("configs", {})]
+    if (result is None or missing) and _remaining() > 90:
+        # CPU pass: either the whole bench (TPU path yielded nothing) or a
+        # gap-filler for the configs the TPU partial is missing
+        _log(f"running CPU fallback "
+             f"({'full' if result is None else 'fill ' + ','.join(missing)}; "
+             f"budget {_remaining() - 30:.0f}s)")
+        cpu_snap = os.path.join(snap_dir, "cpu.json")
+        cpu_res: dict | None = None
         try:
-            result = _run_inner(_cpu_env(), timeout_s=1500.0)
+            cpu_res = _run_inner(_cpu_env(), max(60.0, _remaining() - 30.0),
+                                 cpu_snap)
         except Exception as e:  # noqa: BLE001
             errors.append(f"cpu fallback failed: {type(e).__name__}: {e}"[:300])
             _log(errors[-1])
+        if cpu_res is not None:
+            if result is None:
+                result = cpu_res
+            else:
+                # graft only the missing configs; tag their provenance
+                for name in missing:
+                    cfg = cpu_res.get("configs", {}).get(name)
+                    if cfg is not None:
+                        cfg = dict(cfg)
+                        cfg["device"] = "cpu-fallback"
+                        result.setdefault("configs", {})[name] = cfg
+                still = [c for c in REQUIRED_CONFIGS
+                         if c not in result.get("configs", {})]
+                result["cpu_fill"] = {
+                    "filled": [c for c in missing if c not in still],
+                    "still_missing": still,
+                }
+                # the TPU partial may have died before its headline stage:
+                # a zero headline with a measured CPU one must not ship as
+                # value 0.0 — take the CPU number, labeled
+                if (not result.get("value")) and cpu_res.get("value"):
+                    result["value"] = cpu_res["value"]
+                    result["vs_baseline"] = cpu_res.get("vs_baseline", 0.0)
+                    result["value_device"] = "cpu-fallback"
+            _BEST = dict(result)
 
     if result is None:
         result = {"metric": METRIC_NAME, "value": 0.0, "unit": "txn/s/chip",
                   "vs_baseline": 0.0, "device": "none"}
     result["probe_attempts"] = timeline
+    result["wall_s"] = round(time.monotonic() - _T0, 1)
     history = _session_probe_history()
     if history:
         result["session_probe_history"] = history
     if result.get("device", "").startswith(("cpu", "none")):
-        # relay down at bench time: surface the round's real on-chip
-        # capture (committed during a live relay window) so a wedged relay
-        # can't erase the round's measured TPU performance
-        here = os.path.dirname(os.path.abspath(__file__))
-        try:
-            with open(os.path.join(here, "BENCH_r04_tpu_capture.json")) as f:
-                cap = json.load(f)
-            result["same_round_tpu_capture"] = {
-                "headline": cap.get("headline"),
-                "file": "BENCH_r04_tpu_capture.json",
-                "note": "see capture_note in the file for methodology; "
-                        "instrumented on-chip soak/sweep measurements are "
-                        "recorded in MEASUREMENTS_r04_onchip.json and the "
-                        "post-fix quality measurement in "
-                        "BENCH_r04_quality_cpu.json",
-            }
-        except (OSError, ValueError):
-            pass
+        _attach_tpu_capture(result)
     if errors:
-        result["error"] = "; ".join(errors)[:600]
-    print(json.dumps(result), flush=True)
-    sys.exit(0)
+        existing = result.get("error")
+        result["error"] = "; ".join(([existing] if existing else []) + errors)[:600]
+    _BEST = result
+    _emit_and_exit()
 
 
 def _session_probe_history() -> dict | None:
     """Summarize /tmp/tpu_probe.log (a background probe loop retries the
-    relay every ~10 min across the whole build session) so a full-round
+    relay every ~5-10 min across the whole build session) so a full-round
     outage is evidenced by dozens of timestamped attempts, not just the
     bench-start probes."""
     try:
@@ -282,11 +447,13 @@ def _null_rtt_ms(iters: int = 10) -> dict:
     return _percentiles(ts)
 
 
-def _ensemble_matmul_flops(bert_config, sc, batch: int) -> float:
-    """Analytic matmul FLOPs per fused-ensemble call (counting 2*M*N*K).
+def _ensemble_matmul_flops(bert_config, sc, batch: int) -> dict:
+    """Analytic matmul FLOPs per fused-ensemble call (counting 2*M*N*K),
+    itemized per branch so the accounting visibly covers all five.
 
-    BERT dominates; LSTM/GNN are included; tree + isolation-forest branches
-    are gather/compare programs with ~0 matmul FLOPs.
+    BERT dominates; LSTM/GNN are included; the tree and isolation-forest
+    branches are gather/compare programs — their matmul FLOP count is
+    genuinely 0 (they cost HBM gathers, not MXU cycles), recorded as such.
     """
     h, i_, l_, t = (bert_config.hidden_size, bert_config.intermediate_size,
                     bert_config.num_layers, sc.text_len)
@@ -296,7 +463,14 @@ def _ensemble_matmul_flops(bert_config, sc, batch: int) -> float:
     lstm_h = 128
     lstm = sc.seq_len * 2 * (sc.feature_dim + lstm_h) * 4 * lstm_h
     gnn = 2 * (2 * sc.fanout * sc.node_dim * 64 + 3 * 64 * 64)  # rough, tiny
-    return float(batch * (bert + lstm + gnn))
+    return {
+        "bert_text": float(batch * bert),
+        "lstm_sequential": float(batch * lstm),
+        "graph_neural": float(batch * gnn),
+        "xgboost": 0.0,            # gather/compare over tree nodes
+        "isolation_forest": 0.0,   # gather/compare over split tables
+        "total": float(batch * (bert + lstm + gnn)),
+    }
 
 
 def run_bench() -> None:
@@ -322,16 +496,39 @@ def run_bench() -> None:
     )
     from realtime_fraud_detection_tpu.utils.config import Config
 
+    # ---------------------------------------------------------- budget plumbing
+    deadline_unix = float(os.environ.get("RTFD_BENCH_DEADLINE_UNIX", "0"))
+    snap_path = os.environ.get("RTFD_BENCH_SNAPSHOT", "")
+
+    def remaining() -> float:
+        return (deadline_unix - time.time()) if deadline_unix else float("inf")
+
+    result: dict = {"metric": METRIC_NAME, "value": 0.0, "unit": "txn/s/chip",
+                    "vs_baseline": 0.0, "configs": {}, "partial": True}
+
+    def snapshot(stage: str) -> None:
+        result["last_stage"] = stage
+        if not snap_path:
+            return
+        tmp = snap_path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(result, f)
+            os.replace(tmp, snap_path)
+        except OSError:
+            pass
+
     on_tpu = jax.devices()[0].platform != "cpu"
     device_label = os.environ.get("RTFD_BENCH_DEVICE_LABEL",
                                   str(jax.devices()[0]))
+    result["device"] = device_label
     # Real DistilBERT-base dimensions for the text branch (config.py:165-170),
     # trimmed to 2 layers on CPU so fallback runs stay tractable.
     bert_config = BertConfig() if on_tpu else BertConfig(num_layers=2)
     sc = ScorerConfig(text_len=64)
     # Iteration scale: full on TPU; reduced on the CPU fallback so a wedged
-    # relay still yields a complete JSON well inside the orchestrator timeout.
-    it = (lambda n: n) if on_tpu else (lambda n: max(5, n // 10))
+    # relay still yields a complete JSON well inside the orchestrator budget.
+    it = (lambda n: n) if on_tpu else (lambda n: max(3, n // 30))
 
     models = init_scoring_models(
         jax.random.PRNGKey(0), bert_config=bert_config,
@@ -340,10 +537,11 @@ def run_bench() -> None:
     params = EnsembleParams.from_config(Config(), list(MODEL_NAMES))
     model_valid = jnp.ones((len(MODEL_NAMES),), bool)
 
-    _log(f'start device={jax.devices()[0]}')
+    _log(f'start device={jax.devices()[0]} remaining={remaining():.0f}s')
+    BUCKETS = (1, 32, 64, 128, 256)
     batches = {
         bsz: make_example_batch(bsz, sc, rng=np.random.default_rng(bsz))
-        for bsz in (1, 32, 256)
+        for bsz in BUCKETS
     }
     dev_batches = {b: jax.device_put(v) for b, v in batches.items()}
     dev_models = jax.device_put(models)
@@ -356,7 +554,7 @@ def run_bench() -> None:
     var_feats = {
         b: [jax.device_put(batches[b].features + np.float32(j) * 1e-4)
             for j in range(K)]
-        for b in (1, 32, 256)
+        for b in BUCKETS
     }
     vocab = bert_config.vocab_size
     var_toks = [
@@ -370,6 +568,8 @@ def run_bench() -> None:
     ]
     jax.block_until_ready((var_feats, var_toks, var_hist))
     rtt = _null_rtt_ms() if on_tpu else None
+    result["tunnel_null_rtt_ms"] = rtt
+    snapshot("staged")
 
     # ---------------------------------------------------- pallas vs XLA (BERT)
     # The repo's custom kernel (ops/attention.py) measured head-to-head on
@@ -398,6 +598,8 @@ def run_bench() -> None:
             "pallas_p50_ms": round(pal_ms, 3),
             "headline_uses_pallas": use_pallas,
         }
+    result["pallas"] = pallas_report
+    snapshot("pallas_ab")
 
     _log(f'pallas A/B done: {pallas_report}')
     fn = jax.jit(
@@ -407,53 +609,63 @@ def run_bench() -> None:
         )
     )
 
-    # ------------------------------------------------- latency decomposition
-    # ORDERING CONTRACT: nothing before the `d2h` phase below may call
-    # jax.device_get / np.asarray on a device array. On the axon tunnel the
-    # FIRST device->host pull permanently flips the process into synchronous
-    # round-trip dispatch (~70-170 ms per call) — real v5e PCIe has no such
-    # mode, so every latency/throughput number must be captured in the
-    # pre-pull regime to be representative of the hardware. The d2h phase
-    # and the e2e soak (whose scorer inherently pulls results) run last.
-    lat: dict[str, dict] = {}
-    for bsz, iters in ((1, it(200)), (32, it(100)), (256, it(100))):
-        _log(f'latency decomposition b={bsz}')
-        host_b, dev_b = batches[bsz], dev_batches[bsz]
+    # ------------------------------------------- headline + config 5 FIRST
+    # (stage order is importance order: if the budget kills us early, the
+    # snapshot already carries the headline and config table)
+    db = dev_batches[256]
+    headline_tp = round(_throughput_pipelined(
+        lambda i: fn(dev_models, db.replace(features=var_feats[256][i % K]),
+                     params, model_valid), 256, it(50)), 1)
+    configs: dict = result["configs"]
+    configs["graphsage_full_ensemble"] = {
+        "batch": 256,
+        "txn_per_s": headline_tp,
+    }
+    result["value"] = headline_tp
+    result["vs_baseline"] = round(headline_tp / BASELINE_TPS, 3)
+    _log(f'headline (config 5) done: {headline_tp} txn/s')
+    snapshot("headline")
 
-        # Variation must cover the byte-dominant leaves too (history is
-        # ~45% of the payload): a transfer cache keyed on content would
-        # otherwise still serve most of the repeated bytes.
-        def _host_variant(i, hb=host_b):
-            return hb.replace(
-                features=hb.features + np.float32(i) * 1e-4,
-                history=hb.history + np.float32(i) * 1e-4,
-                token_ids=((hb.token_ids + i) % vocab).astype(np.int32),
-            )
+    # -------------------------------------------------------------------- MFU
+    # Achieved matmul TFLOP/s of the fused batch=256 program against the
+    # chip's bf16 peak. FLOPs are analytic (2*M*N*K per matmul, all five
+    # branches itemized); time per batch is derived from the PIPELINED
+    # throughput (batch/txn_per_s): with the device kept fed, the
+    # steady-state batch period is bounded below by pure device compute, so
+    # the resulting MFU is an honest lower bound that no transfer cache or
+    # async-dispatch artifact can inflate (r3's blocked-call timing produced
+    # an impossible 647% MFU through exactly such an artifact).
+    flops = _ensemble_matmul_flops(bert_config, sc, 256)
+    sec_per_batch = 256.0 / max(headline_tp, 1e-9)
+    achieved_tflops = flops["total"] / sec_per_batch / 1e12
+    peak = next((v for k, v in _PEAK_BF16_TFLOPS
+                 if k in str(jax.devices()[0]).lower()), None)
+    mfu_val = (achieved_tflops / peak) if peak else None
+    mfu = {
+        "matmul_flops_batch256_by_branch": flops,
+        "sec_per_batch_pipelined": round(sec_per_batch, 6),
+        "achieved_tflops": round(achieved_tflops, 3),
+        "peak_bf16_tflops": peak,
+        "method": "throughput-derived (batch / pipelined txn_per_s); "
+                  "tree + iforest branches are gather/compare programs "
+                  "with 0 matmul FLOPs by construction",
+        "expected": "BERT-distil (6x768, seq 64) dominates at ~1.4 TFLOP "
+                    "per 256-batch; at ~10k txn/s that is ~50 TFLOP/s — "
+                    "tens of percent of a v5e peak, a latency-oriented "
+                    "inference program, not a saturating training step",
+    }
+    # VERDICT r4 item 4: a bogus MFU must never be emitted. Outside (0, 1)
+    # the number is refused and the violation itself is reported.
+    if mfu_val is not None and not (0.0 < mfu_val < 1.0):
+        mfu["mfu"] = None
+        mfu["error"] = (f"implausible mfu {mfu_val:.4f} (must be in (0,1)) — "
+                        f"refusing to report; timing or peak mapping is wrong")
+    else:
+        mfu["mfu"] = round(mfu_val, 4) if mfu_val is not None else None
+    result["mfu"] = mfu
+    snapshot("mfu")
 
-        e2e = _time_blocked(
-            lambda i: fn(dev_models, _host_variant(i), params, model_valid),
-            iters)
-        device = _time_blocked(
-            lambda i: fn(dev_models,
-                         dev_b.replace(features=var_feats[bsz][i % K]),
-                         params, model_valid), iters)
-        # H2D in isolation: push a fresh host batch each call, block
-        h2d = []
-        for i in range(min(iters, 50)):
-            hb = _host_variant(i + 1000)
-            t0 = time.perf_counter()
-            jax.block_until_ready(jax.device_put(hb))
-            h2d.append(time.perf_counter() - t0)
-        lat[str(bsz)] = {
-            "e2e": _percentiles(e2e),
-            "device": _percentiles(device),
-            "h2d": _percentiles(h2d),
-        }
-
-    # --------------------------------------------------- the 5 BASELINE configs
-    _log('latency decomposition done')
-    configs: dict[str, dict] = {}
-
+    # ------------------------------------------- the other 4 BASELINE configs
     # 1. XGBoost batch=1 (the reference's unbatched hot path, main.py:235-248)
     tfn = jax.jit(lambda t, f: tree_ensemble_predict(t, f))
     configs["xgboost_batch1"] = {
@@ -463,6 +675,7 @@ def run_bench() -> None:
             lambda i: tfn(dev_models.trees, var_feats[1][i % K]),
             1, it(200)), 1),
     }
+    snapshot("config1")
     _log('config 1 (xgb b=1) done')
     # 2. XGB + IsolationForest ensemble, microbatch=32
     v2 = jnp.asarray([True, False, False, False, True])
@@ -487,6 +700,7 @@ def run_bench() -> None:
                            var_feats[32][i % K]),
             32, it(200)), 1),
     }
+    snapshot("config2")
 
     _log('config 2 (xgb+iforest mb32) done')
     # 3. BERT encoder -> fraud head (DistilBERT-base on TPU, seq 64)
@@ -502,13 +716,39 @@ def run_bench() -> None:
         "layers": bert_config.num_layers,
         "hidden": bert_config.hidden_size,
     }
+    snapshot("config3")
+
+    # 4. LSTM per-user sequential model
+    hlen = dev_batches[256].history_len
+    lfn = jax.jit(lambda p, h, l: jax.nn.sigmoid(lstm_logits(p, h, l)))
+    configs["lstm_seq"] = {
+        "batch": 256,
+        "latency": _percentiles(_time_blocked(
+            lambda i: lfn(dev_models.lstm, var_hist[i % K], hlen), it(100))),
+        "txn_per_s": round(_throughput_pipelined(
+            lambda i: lfn(dev_models.lstm, var_hist[i % K], hlen),
+            256, it(100)), 1),
+    }
+    snapshot("config4")
+    _log('configs 1-5 done; all 5 BASELINE configs in the snapshot')
 
     # 3b. honest sequence lengths (VERDICT r3 missing-6): the reference
     # tokenizes at max_length 512 (bert_text_analyzer.py:201-202); seq 64
     # is the production truncation for short merchant/description strings.
     # Bench 128 everywhere and 512 on the real chip so the text branch's
     # cost at reference length is on the record.
-    for seq_len in (128, 512) if on_tpu else (128,):
+    # CPU fallback runs the soak FIRST (no tunnel => no pull-ordering
+    # constraint; quality is worth more than long-seq/sweep detail there)
+    if not on_tpu and remaining() > 100:
+        try:
+            _e2e_soak(result, models, sc, bert_config, use_pallas, on_tpu,
+                      remaining, snapshot)
+        except Exception as e:
+            result["e2e_stream"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+
+    seq_variants = (128, 512) if (on_tpu and remaining() > 240) else \
+                   ((128,) if remaining() > 180 else ())
+    for seq_len in seq_variants:
         rng = np.random.default_rng(seq_len)
         toks_l = [jax.device_put(rng.integers(
             0, 30_000, (256, seq_len)).astype(np.int32)) for _ in range(K)]
@@ -522,33 +762,98 @@ def run_bench() -> None:
                 lambda i: bfn(dev_models.bert, toks_l[i % K], mask_l),
                 256, it(30)), 1),
         }
+        snapshot(f"bert_seq{seq_len}")
+    _log('long-seq BERT variants done')
 
-    _log('config 3 (bert, + long-seq variants) done')
-    # 4. LSTM per-user sequential model
-    hlen = dev_batches[256].history_len
-    lfn = jax.jit(lambda p, h, l: jax.nn.sigmoid(lstm_logits(p, h, l)))
-    configs["lstm_seq"] = {
-        "batch": 256,
-        "latency": _percentiles(_time_blocked(
-            lambda i: lfn(dev_models.lstm, var_hist[i % K], hlen), it(100))),
-        "txn_per_s": round(_throughput_pipelined(
-            lambda i: lfn(dev_models.lstm, var_hist[i % K], hlen),
-            256, it(100)), 1),
-    }
+    # ------------------------------------------ bucket sweep + latency decomp
+    # VERDICT r4 item 3: the p99<20 ms operating point. For each microbatch
+    # bucket: blocked-call latency (raw AND net of the measured tunnel null
+    # RTT — the transport floor a local-PCIe deployment would not pay), the
+    # pipelined batch period, and the throughput the bucket sustains.
+    #
+    # ORDERING CONTRACT: nothing before the `d2h` phase below may call
+    # jax.device_get / np.asarray on a device array. On the axon tunnel the
+    # FIRST device->host pull permanently flips the process into synchronous
+    # round-trip dispatch (~70-170 ms per call) — real v5e PCIe has no such
+    # mode, so every latency/throughput number must be captured in the
+    # pre-pull regime to be representative of the hardware.
+    lat: dict[str, dict] = {}
+    sweep: dict[str, dict] = {}
+    rtt_floor = (rtt or {}).get("p50_ms", 0.0)
+    sweep_buckets = BUCKETS if on_tpu else (1, 32, 256)
+    for bsz in sweep_buckets:
+        if remaining() < 60 and bsz not in (32, 256):
+            continue
+        _log(f'bucket sweep b={bsz}')
+        iters = it(100 if bsz >= 128 else 150)
+        host_b, dev_b = batches[bsz], dev_batches[bsz]
 
-    _log('config 4 (lstm) done')
-    # 5. GraphSAGE + full 4-model ensemble = the fused headline program
-    db = dev_batches[256]
-    configs["graphsage_full_ensemble"] = {
-        "batch": 256,
-        "latency": lat["256"]["device"],
-        "txn_per_s": round(_throughput_pipelined(
+        # Variation must cover the byte-dominant leaves too (history is
+        # ~45% of the payload): a transfer cache keyed on content would
+        # otherwise still serve most of the repeated bytes.
+        def _host_variant(i, hb=host_b):
+            return hb.replace(
+                features=hb.features + np.float32(i) * 1e-4,
+                history=hb.history + np.float32(i) * 1e-4,
+                token_ids=((hb.token_ids + i) % vocab).astype(np.int32),
+            )
+
+        device = _time_blocked(
             lambda i: fn(dev_models,
-                         db.replace(features=var_feats[256][i % K]),
-                         params, model_valid), 256, it(50)), 1),
-    }
+                         dev_b.replace(features=var_feats[bsz][i % K]),
+                         params, model_valid), iters)
+        tp = _throughput_pipelined(
+            lambda i: fn(dev_models,
+                         dev_b.replace(features=var_feats[bsz][i % K]),
+                         params, model_valid), bsz, iters)
+        dp = _percentiles(device)
+        entry = {
+            "batch": bsz,
+            "blocked_p50_ms": dp["p50_ms"],
+            "blocked_p99_ms": dp["p99_ms"],
+            "p50_net_of_rtt_ms": round(max(dp["p50_ms"] - rtt_floor, 0.0), 3),
+            "p99_net_of_rtt_ms": round(max(dp["p99_ms"] - rtt_floor, 0.0), 3),
+            "pipelined_ms_per_batch": round(1e3 * bsz / max(tp, 1e-9), 3),
+            "txn_per_s": round(tp, 1),
+        }
+        entry["meets_p99_20ms"] = entry["p99_net_of_rtt_ms"] < 20.0
+        sweep[str(bsz)] = entry
+        lat[str(bsz)] = {"device": dp}
 
-    throughput = configs["graphsage_full_ensemble"]["txn_per_s"]
+        # host-resident e2e (includes H2D + dispatch round trip) for the
+        # three canonical sizes only — it costs a full h2d per call
+        if bsz in (1, 32, 256):
+            e2e = _time_blocked(
+                lambda i: fn(dev_models, _host_variant(i), params,
+                             model_valid), min(iters, it(100)))
+            h2d = []
+            for i in range(min(iters, 50)):
+                hb = _host_variant(i + 1000)
+                t0 = time.perf_counter()
+                jax.block_until_ready(jax.device_put(hb))
+                h2d.append(time.perf_counter() - t0)
+            lat[str(bsz)]["e2e"] = _percentiles(e2e)
+            lat[str(bsz)]["h2d"] = _percentiles(h2d)
+        snapshot(f"sweep_{bsz}")
+
+    passing = [e for e in sweep.values() if e.get("meets_p99_20ms")]
+    result["bucket_sweep"] = {
+        "note": "p99 net of the measured tunnel null RTT (the transport "
+                "floor; local-PCIe deployments do not pay it). The "
+                "operating point is the largest passing bucket — latency "
+                "budget met at the highest sustained throughput.",
+        "rtt_floor_ms": rtt_floor,
+        "buckets": sweep,
+        "passing": sorted((e["batch"] for e in passing)),
+        "operating_point": (max(passing, key=lambda e: e["txn_per_s"])
+                            if passing else None),
+    }
+    result["latency"] = lat
+    configs["graphsage_full_ensemble"]["latency"] = \
+        lat.get("256", {}).get("device")
+    snapshot("bucket_sweep")
+    _log(f'bucket sweep done; passing buckets: '
+         f'{result["bucket_sweep"]["passing"]}')
 
     # Derived device-resident batch period: batch / pipelined-throughput.
     # Blocked per-call latency on a tunneled chip is dominated by the ~85 ms
@@ -560,237 +865,238 @@ def run_bench() -> None:
         if cfg.get("txn_per_s"):
             cfg["ms_per_batch_pipelined"] = round(1e3 * b / cfg["txn_per_s"], 3)
 
-    _log('config 5 (full ensemble) done')
-    # -------------------------------------------------------------------- MFU
-    # Achieved matmul TFLOP/s of the fused batch=256 program against the
-    # chip's bf16 peak (VERDICT r2 item 8). FLOPs are analytic (counted from
-    # the model dims, 2*M*N*K per matmul); time per batch is derived from the
-    # PIPELINED throughput (batch/txn_per_s): with the device kept fed, the
-    # steady-state batch period is bounded below by pure device compute, so
-    # the resulting MFU is an honest lower bound that no transfer cache or
-    # async-dispatch artifact can inflate (r3's blocked-call timing produced
-    # an impossible 647% MFU through exactly such an artifact).
-    flops = _ensemble_matmul_flops(bert_config, sc, 256)
-    sec_per_batch = 256.0 / max(throughput, 1e-9)
-    achieved_tflops = flops / sec_per_batch / 1e12
-    peak = next((v for k, v in _PEAK_BF16_TFLOPS
-                 if k in str(jax.devices()[0]).lower()), None)
-    mfu = {
-        "matmul_flops_batch256": flops,
-        "sec_per_batch_pipelined": round(sec_per_batch, 6),
-        "achieved_tflops": round(achieved_tflops, 3),
-        "peak_bf16_tflops": peak,
-        "mfu": round(achieved_tflops / peak, 4) if peak else None,
-        "method": "throughput-derived (batch / pipelined txn_per_s)",
-    }
-
     # ---------------------------------------------------------- d2h phase
     # The FIRST device->host pulls in this process — deliberately last (see
     # the ordering contract above): after these, the tunnel pins every
     # dispatch to synchronous round trips, which the e2e soak below (whose
     # scorer inherently pulls results per batch) already has to live with.
-    for bsz in (1, 32, 256):
-        dev_b = dev_batches[bsz]
-        d2h = []
-        # several rounds of K fresh outputs: each Array is pulled exactly
-        # once (a re-pull reads jax's cached _npy_value), and 3*K samples
-        # keep the p99 from being a single worst pull
-        for rnd in range(3):
-            outs = [fn(dev_models,
-                       dev_b.replace(
-                           features=var_feats[bsz][j] + np.float32(rnd)),
-                       params, model_valid) for j in range(K)]
-            jax.block_until_ready(outs)
-            for o in outs:
-                t0 = time.perf_counter()
-                jax.device_get(o)
-                d2h.append(time.perf_counter() - t0)
-        lat[str(bsz)]["d2h"] = _percentiles(d2h)
-    _log('d2h phase done (process now in tunnel sync-dispatch mode)')
+    if remaining() > 45:
+        for bsz in (1, 32, 256):
+            if str(bsz) not in lat:      # bucket skipped under low budget
+                continue
+            dev_b = dev_batches[bsz]
+            d2h = []
+            # several rounds of K fresh outputs: each Array is pulled exactly
+            # once (a re-pull reads jax's cached _npy_value), and 3*K samples
+            # keep the p99 from being a single worst pull
+            for rnd in range(3):
+                outs = [fn(dev_models,
+                           dev_b.replace(
+                               features=var_feats[bsz][j] + np.float32(rnd)),
+                           params, model_valid) for j in range(K)]
+                jax.block_until_ready(outs)
+                for o in outs:
+                    t0 = time.perf_counter()
+                    jax.device_get(o)
+                    d2h.append(time.perf_counter() - t0)
+            lat[str(bsz)]["d2h"] = _percentiles(d2h)
+        snapshot("d2h")
+        _log('d2h phase done (process now in tunnel sync-dispatch mode)')
 
-    # native C++ tree kernel, the true CPU baseline for config 1 (pulls the
-    # tree params to host, hence scheduled in the post-pull phase)
-    try:
-        from realtime_fraud_detection_tpu.native import NativeTreeScorer
+        # native C++ tree kernel, the true CPU baseline for config 1 (pulls
+        # the tree params to host, hence scheduled in the post-pull phase)
+        try:
+            from realtime_fraud_detection_tpu.native import NativeTreeScorer
 
-        scorer_cpu = NativeTreeScorer(jax.device_get(models.trees))
-        feats1 = np.asarray(batches[1].features)
-        t0 = time.perf_counter()
-        n_iters = it(2000)
-        for _ in range(n_iters):
-            scorer_cpu.predict(feats1)
-        cpu_s = (time.perf_counter() - t0) / n_iters
-        configs["xgboost_batch1"]["cpu_native_p50_ms"] = round(cpu_s * 1e3, 4)
-    except Exception:
-        pass
+            scorer_cpu = NativeTreeScorer(jax.device_get(models.trees))
+            feats1 = np.asarray(batches[1].features)
+            t0 = time.perf_counter()
+            n_iters = it(2000)
+            for _ in range(n_iters):
+                scorer_cpu.predict(feats1)
+            cpu_s = (time.perf_counter() - t0) / n_iters
+            configs["xgboost_batch1"]["cpu_native_p50_ms"] = round(
+                cpu_s * 1e3, 4)
+        except Exception:
+            pass
 
     # ------------------------------------------------------- e2e stream soak
-    # Runs with TRAINED trees so the soak measures the production pipeline,
+    # Runs with TRAINED models so the soak measures the production pipeline,
     # and doubles as the detection-quality measurement: the reference CLAIMS
     # 96.8% accuracy with no benchmark harness (README.md:203, SURVEY.md §6);
     # this is a measured number on a stream with a known injected fraud mix.
-    e2e_stream = {}
-    quality = {}
-    try:
-        from realtime_fraud_detection_tpu.scoring import FraudScorer
-        from realtime_fraud_detection_tpu.sim.simulator import (
-            TransactionGenerator,
-        )
-        from realtime_fraud_detection_tpu.stream import (
-            InMemoryBroker,
-            JobConfig,
-            StreamJob,
-        )
-        from realtime_fraud_detection_tpu.stream import topics as T
-        from realtime_fraud_detection_tpu.training import GBDTTrainer
-
-        from realtime_fraud_detection_tpu.models.isolation_forest import (
-            IsolationForestTrainer,
-        )
-        from realtime_fraud_detection_tpu.scoring import MODEL_NAMES as _MN
-
-        gen = TransactionGenerator(num_users=2000, num_merchants=500, seed=3)
-        broker = InMemoryBroker()
-        scorer = FraudScorer(
-            models=models, scorer_config=sc, bert_config=bert_config)
-        scorer.sc.use_pallas = use_pallas
-        scorer.seed_profiles(gen.users.profiles(), gen.merchants.profiles())
-
-        # Train on STREAMED features: run the training transactions through
-        # the production assemble path (live velocity/history/graph state)
-        # so the trees see the distribution they will score — training on
-        # offline-encoded features costs ~2pp accuracy / ~0.04 AUC on the
-        # stream (r4 measurement). assemble() is host-only, so this phase
-        # costs no device time. The reference never wired its trainer to
-        # its stream at all (SURVEY.md §0.3).
-        _log('e2e soak: streaming training features')
-        tr_feats, tr_labels = [], []
-        for _ in range(48):
-            recs = gen.generate_batch(256)
-            b = scorer.assemble(recs)
-            tr_feats.append(np.asarray(b.features))
-            tr_labels.append(np.asarray(
-                [bool(r.get("is_fraud")) for r in recs], np.float32))
-            ts = time.time()
-            for r in recs:
-                scorer.velocity.update(str(r.get("user_id", "")),
-                                       float(r.get("amount", 0.0)), ts)
-        x_tr = np.concatenate(tr_feats)
-        y_tr = np.concatenate(tr_labels)
-        _log('e2e soak: fitting trees + isolation forest')
-        gtr = GBDTTrainer(n_estimators=40, max_depth=5, seed=2)
-        trees = gtr.fit(x_tr, y_tr)
-        iforest = IsolationForestTrainer(n_estimators=100, seed=4).fit(
-            x_tr[y_tr < 0.5][:6000])
-        scorer.set_models(models.replace(trees=trees, iforest=iforest))
-        scorer.set_feature_importances(gtr.feature_importances_)
-        # Production blend: the untrained neural branches stay ENABLED on
-        # device (they execute in the fused program — the throughput number
-        # is the full 5-branch program) but are masked out of the score
-        # blend via the per-branch validity feature (§2.2) exactly as a
-        # deployment would gate cold models; weights renormalize to the
-        # trained branches.
-        for name in ("lstm_sequential", "bert_text", "graph_neural"):
-            scorer.model_valid[list(_MN).index(name)] = False
-        job = StreamJob(broker, scorer,
-                        JobConfig(max_batch=256, emit_features=False,
-                                  pipeline_depth=3))
-        labels: dict = {}
-
-        def _produce(n_txn: int) -> None:
-            recs = gen.generate_batch(n_txn)
-            labels.update(
-                (str(r["transaction_id"]), bool(r.get("is_fraud")))
-                for r in recs)
-            broker.produce_batch(T.TRANSACTIONS, recs,
-                                 key_fn=lambda r: str(r["user_id"]))
-
-        if on_tpu:
-            # sustained soak (VERDICT r3 item 5): pre-fill well past what
-            # the chip can score in the window so the job never starves,
-            # then run_for a fixed wall-clock window — sustained txn/s,
-            # not a drain of a finite backlog
-            soak_s = 30.0
-            _log('e2e soak: generating backlog')
-            for _ in range(12):
-                _produce(20_000)
-            # Warm the streaming scorer OUTSIDE the window: the first call
-            # compiles the bucket-256 fused program (tens of seconds over
-            # the tunnel), which in r4's first run silently ate most of the
-            # 30 s window (76 txn/s "sustained" was ~25 s of XLA compile).
-            _log('e2e soak: warming (compile outside the window)')
-            scorer.score_batch(gen.generate_batch(256))
-            t0 = time.perf_counter()
-            scored = job.run_for(soak_s)
-            dt = time.perf_counter() - t0
+    # (On the TPU it must run LAST: its result pulls flip the tunnel into
+    # sync-dispatch mode. The CPU fallback already ran it earlier.)
+    if "e2e_stream" not in result:
+        if remaining() > 150.0:
+            try:
+                _e2e_soak(result, models, sc, bert_config, use_pallas,
+                          on_tpu, remaining, snapshot)
+            except Exception as e:
+                result["e2e_stream"] = {
+                    "error": f"{type(e).__name__}: {e}"[:200]}
         else:
-            _produce(3_000)
-            t0 = time.perf_counter()
-            scored = job.run_until_drained(now=1000.0)
-            dt = time.perf_counter() - t0
-        e2e_stream = {
-            "txn_per_s": round(scored / dt, 1),
-            "scored": scored,
-            "window_s": round(dt, 1),
-            "sustained": bool(on_tpu),
-            "batches": job.counters["batches"],
-            # configuration the number was measured under
-            "pipeline_depth": job.config.pipeline_depth,
-            "transfer_bf16": scorer.sc.transfer_bf16,
-            "max_batch": job.config.max_batch,
+            result["e2e_stream"] = {
+                "skipped": f"budget ({remaining():.0f}s left < 150s soak "
+                           f"minimum)"}
+
+    result["partial"] = False
+    snapshot("complete")
+    _log(f'done: e2e_stream={result.get("e2e_stream")}; '
+         f'quality={result.get("quality")}')
+    print(json.dumps(result), flush=True)
+
+
+def _e2e_soak(result: dict, models, sc, bert_config, use_pallas: bool,
+              on_tpu: bool, remaining, snapshot) -> None:
+    """The whole-framework StreamJob soak + measured detection quality."""
+    import numpy as np
+
+    from realtime_fraud_detection_tpu.models.isolation_forest import (
+        IsolationForestTrainer,
+    )
+    from realtime_fraud_detection_tpu.scoring import (
+        MODEL_NAMES as _MN,
+        FraudScorer,
+    )
+    from realtime_fraud_detection_tpu.sim.simulator import (
+        TransactionGenerator,
+    )
+    from realtime_fraud_detection_tpu.stream import (
+        InMemoryBroker,
+        JobConfig,
+        StreamJob,
+    )
+    from realtime_fraud_detection_tpu.stream import topics as T
+    from realtime_fraud_detection_tpu.training import GBDTTrainer
+
+    _log('e2e soak: start')
+    gen = TransactionGenerator(num_users=2000, num_merchants=500, seed=3)
+    broker = InMemoryBroker()
+    scorer = FraudScorer(
+        models=models, scorer_config=sc, bert_config=bert_config)
+    scorer.sc.use_pallas = use_pallas
+    scorer.seed_profiles(gen.users.profiles(), gen.merchants.profiles())
+
+    # Train on STREAMED features: run the training transactions through
+    # the production assemble path (live velocity/history/graph state)
+    # so the trees see the distribution they will score — training on
+    # offline-encoded features costs ~2pp accuracy / ~0.04 AUC on the
+    # stream (r4 measurement). assemble() is host-only, so this phase
+    # costs no device time. The reference never wired its trainer to
+    # its stream at all (SURVEY.md §0.3).
+    _log('e2e soak: streaming training features')
+    tr_feats, tr_labels = [], []
+    n_train_batches = 48 if remaining() > 240 else 24
+    for _ in range(n_train_batches):
+        recs = gen.generate_batch(256)
+        b = scorer.assemble(recs)
+        tr_feats.append(np.asarray(b.features))
+        tr_labels.append(np.asarray(
+            [bool(r.get("is_fraud")) for r in recs], np.float32))
+        ts = time.time()
+        for r in recs:
+            scorer.velocity.update(str(r.get("user_id", "")),
+                                   float(r.get("amount", 0.0)), ts)
+    x_tr = np.concatenate(tr_feats)
+    y_tr = np.concatenate(tr_labels)
+    _log('e2e soak: fitting trees + isolation forest')
+    gtr = GBDTTrainer(n_estimators=40, max_depth=5, seed=2)
+    trees = gtr.fit(x_tr, y_tr)
+    iforest = IsolationForestTrainer(n_estimators=100, seed=4).fit(
+        x_tr[y_tr < 0.5][:6000])
+    scorer.set_models(models.replace(trees=trees, iforest=iforest))
+    scorer.set_feature_importances(gtr.feature_importances_)
+    # Production blend: the untrained neural branches stay ENABLED on
+    # device (they execute in the fused program — the throughput number
+    # is the full 5-branch program) but are masked out of the score
+    # blend via the per-branch validity feature (§2.2) exactly as a
+    # deployment would gate cold models; weights renormalize to the
+    # trained branches.
+    for name in ("lstm_sequential", "bert_text", "graph_neural"):
+        scorer.model_valid[list(_MN).index(name)] = False
+    # VERDICT r4 item 2 levers: batch 512 (fewer per-batch overheads per
+    # txn), depth 3 (result transfer off the critical path)
+    soak_batch = int(os.environ.get("RTFD_SOAK_MAX_BATCH",
+                                    "512" if on_tpu else "256"))
+    job = StreamJob(broker, scorer,
+                    JobConfig(max_batch=soak_batch, emit_features=False,
+                              pipeline_depth=3))
+    labels: dict = {}
+
+    def _produce(n_txn: int) -> None:
+        recs = gen.generate_batch(n_txn)
+        labels.update(
+            (str(r["transaction_id"]), bool(r.get("is_fraud")))
+            for r in recs)
+        broker.produce_batch(T.TRANSACTIONS, recs,
+                             key_fn=lambda r: str(r["user_id"]))
+
+    if on_tpu:
+        # sustained soak (VERDICT r3 item 5): pre-fill well past what
+        # the chip can score in the window so the job never starves,
+        # then run_for a fixed wall-clock window — sustained txn/s,
+        # not a drain of a finite backlog
+        soak_s = min(30.0, max(10.0, remaining() - 60.0))
+        _log('e2e soak: generating backlog')
+        for _ in range(12):
+            _produce(20_000)
+        # Warm the streaming scorer OUTSIDE the window: the first call
+        # compiles the bucket fused program (tens of seconds over the
+        # tunnel), which in r4's first run silently ate most of the
+        # 30 s window (76 txn/s "sustained" was ~25 s of XLA compile).
+        _log('e2e soak: warming (compile outside the window)')
+        scorer.score_batch(gen.generate_batch(soak_batch))
+        t0 = time.perf_counter()
+        scored = job.run_for(soak_s)
+        dt = time.perf_counter() - t0
+    else:
+        _produce(3_000)
+        t0 = time.perf_counter()
+        scored = job.run_until_drained(now=1000.0)
+        dt = time.perf_counter() - t0
+    result["e2e_stream"] = {
+        "txn_per_s": round(scored / dt, 1),
+        "scored": scored,
+        "window_s": round(dt, 1),
+        "sustained": bool(on_tpu),
+        "batches": job.counters["batches"],
+        # configuration the number was measured under
+        "pipeline_depth": job.config.pipeline_depth,
+        "transfer_bf16": scorer.sc.transfer_bf16,
+        "max_batch": job.config.max_batch,
+    }
+    snapshot("e2e_stream")
+
+    # detection quality from the soak's own predictions
+    preds = broker.consumer([T.PREDICTIONS], "bench-quality").poll(
+        max(scored, 1))
+    y, s = [], []
+    for p in preds:
+        lab = labels.get(p.value.get("transaction_id"))
+        if lab is not None:
+            y.append(float(lab))
+            s.append(float(p.value["fraud_probability"]))
+    y_arr, s_arr = np.asarray(y), np.asarray(s)
+    if len(y_arr) and 0 < y_arr.sum() < len(y_arr):
+        order = np.argsort(s_arr)
+        rank = np.empty(len(s_arr))
+        rank[order] = np.arange(1, len(s_arr) + 1)
+        pos = y_arr > 0.5
+        n_pos, n_neg = int(pos.sum()), int((~pos).sum())
+        auc = float((rank[pos].sum() - n_pos * (n_pos + 1) / 2)
+                    / (n_pos * n_neg))
+        flag = s_arr >= 0.5
+        tp = float((flag & pos).sum())
+        result["quality"] = {
+            "n_scored": len(y_arr),
+            "fraud_rate": round(float(pos.mean()), 4),
+            "auc": round(auc, 4),
+            "accuracy": round(float((flag == pos).mean()), 4),
+            "precision": round(tp / max(int(flag.sum()), 1), 4),
+            "recall": round(tp / max(n_pos, 1), 4),
+            "blend": "trees+iforest trained on streamed features; "
+                     "untrained neural branches execute on device but "
+                     "are blend-masked (per-branch validity, §2.2)",
+            "reference_claim": "96.8% accuracy, unmeasured "
+                               "(reference README.md:203)",
         }
+        snapshot("quality")
 
-        # detection quality from the soak's own predictions
-        preds = broker.consumer([T.PREDICTIONS], "bench-quality").poll(
-            max(scored, 1))
-        y, s = [], []
-        for p in preds:
-            lab = labels.get(p.value.get("transaction_id"))
-            if lab is not None:
-                y.append(float(lab))
-                s.append(float(p.value["fraud_probability"]))
-        y_arr, s_arr = np.asarray(y), np.asarray(s)
-        if len(y_arr) and 0 < y_arr.sum() < len(y_arr):
-            order = np.argsort(s_arr)
-            rank = np.empty(len(s_arr))
-            rank[order] = np.arange(1, len(s_arr) + 1)
-            pos = y_arr > 0.5
-            n_pos, n_neg = int(pos.sum()), int((~pos).sum())
-            auc = float((rank[pos].sum() - n_pos * (n_pos + 1) / 2)
-                        / (n_pos * n_neg))
-            flag = s_arr >= 0.5
-            tp = float((flag & pos).sum())
-            quality = {
-                "n_scored": len(y_arr),
-                "fraud_rate": round(float(pos.mean()), 4),
-                "auc": round(auc, 4),
-                "accuracy": round(float((flag == pos).mean()), 4),
-                "precision": round(tp / max(int(flag.sum()), 1), 4),
-                "recall": round(tp / max(n_pos, 1), 4),
-                "blend": "trees+iforest trained on streamed features; "
-                         "untrained neural branches execute on device but "
-                         "are blend-masked (per-branch validity, §2.2)",
-                "reference_claim": "96.8% accuracy, unmeasured "
-                                   "(reference README.md:203)",
-            }
-    except Exception as e:
-        e2e_stream = {"error": f"{type(e).__name__}: {e}"[:200]}
 
-    _log(f'e2e stream soak done: {e2e_stream}; quality: {quality}')
-    print(json.dumps({
-        "metric": METRIC_NAME,
-        "value": throughput,
-        "unit": "txn/s/chip",
-        "vs_baseline": round(throughput / BASELINE_TPS, 3),
-        "configs": configs,
-        "latency": lat,
-        "tunnel_null_rtt_ms": rtt,
-        "pallas": pallas_report,
-        "mfu": mfu,
-        "e2e_stream": e2e_stream,
-        "quality": quality,
-        "device": device_label,
-    }), flush=True)
+def main() -> None:
+    """Entry point for ``rtfd bench`` (cli.py cmd_bench)."""
+    orchestrate()
 
 
 if __name__ == "__main__":
