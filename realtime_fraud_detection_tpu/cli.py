@@ -464,9 +464,16 @@ def cmd_broker(args: argparse.Namespace) -> int:
     from realtime_fraud_detection_tpu.stream.netbroker import BrokerServer
 
     server = BrokerServer(host=args.host, port=args.port,
-                          log_dir=args.log_dir or None).start()
+                          log_dir=args.log_dir or None,
+                          role=getattr(args, "role", "primary"),
+                          min_isr=getattr(args, "min_isr", 1)).start()
+    for addr in getattr(args, "replica", []) or []:
+        rhost, _, rport = addr.rpartition(":")
+        server.add_replica(rhost or "127.0.0.1", int(rport))
+        print(f"replica {addr} caught up and in sync", file=sys.stderr)
     print(f"broker listening on {args.host}:{server.port}"
-          + (f" (log_dir={args.log_dir})" if args.log_dir else ""),
+          + (f" (log_dir={args.log_dir})" if args.log_dir else "")
+          + (f" role={server.role} min_isr={server.min_isr}"),
           file=sys.stderr)
     try:
         threading_event_wait()
@@ -628,6 +635,17 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--port", type=int, default=9092)
     sp.add_argument("--log-dir", default="",
                     help="write-ahead segment dir (empty = in-memory only)")
+    sp.add_argument("--role", choices=("primary", "replica"),
+                    default="primary",
+                    help="replica = read-only standby until promoted")
+    sp.add_argument("--min-isr", type=int, default=1,
+                    help="in-sync copies (self included) a produce must "
+                         "reach before the ack (create-topics.sh minISR=2 "
+                         "analog)")
+    sp.add_argument("--replica", action="append", default=[],
+                    metavar="HOST:PORT",
+                    help="attach a running replica server (repeatable); "
+                         "each is caught up then joins the ISR")
     sp.set_defaults(fn=cmd_broker)
 
     sp = sub.add_parser("state-server",

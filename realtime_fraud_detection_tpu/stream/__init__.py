@@ -19,7 +19,9 @@ from realtime_fraud_detection_tpu.stream.transport import (  # noqa: F401
 from realtime_fraud_detection_tpu.stream.kafka import KafkaBroker  # noqa: F401
 from realtime_fraud_detection_tpu.stream.netbroker import (  # noqa: F401
     BrokerServer,
+    HaBrokerClient,
     NetBrokerClient,
+    NotEnoughReplicasError,
 )
 from realtime_fraud_detection_tpu.stream.gateway import (  # noqa: F401
     IngressGateway,

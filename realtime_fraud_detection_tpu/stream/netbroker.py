@@ -21,6 +21,31 @@ transport genuinely external without taking a client-library dependency:
   remote broker. One TCP connection, pipelined request/response framing,
   thread-safe.
 
+Replication (the RF/minISR story — reference runs 3 brokers with RF=3,
+minISR=2, scripts/setup/create-topics.sh:9-12):
+
+- A second ``BrokerServer`` started with ``role="replica"`` serves reads
+  but refuses writes (``READONLY``). ``primary.add_replica(host, port)``
+  catches it up (topic layout, record backlog, group offsets) and then
+  ships every produce to it SYNCHRONOUSLY before the producer's ack —
+  the acks=all analog. ``min_isr`` gates the ack: a produce that cannot
+  reach ``min_isr`` in-sync copies (self included) fails loudly instead of
+  pretending durability. A replica that errors is dropped from the ISR
+  (exactly Kafka's shrink-then-ack behavior with minISR).
+- Offset commits are forwarded to replicas too, so a promoted replica
+  resumes every consumer group where the dead primary acked it.
+- ``promote()`` (or the ``promote`` wire op) flips a replica to primary.
+- ``HaBrokerClient([(h1, p1), (h2, p2)])`` is the client side of failover:
+  on connection loss or READONLY it rotates to the next address and
+  retries. A retried produce can duplicate (at-least-once, like any
+  acks=all producer retry) — consumers dedupe by transaction id
+  (stream/job.py dispatch_batch).
+
+Acked-record guarantee: an acked produce is fsync'd on the primary's WAL
+AND applied on min_isr-1 replicas (their WALs included) before the ack, so
+SIGKILL of the primary loses nothing acked — pinned by the kill-the-primary
+soak in tests/test_netbroker.py.
+
 The wire format is 4-byte big-endian length + JSON — deliberately boring:
 the contract (offsets, groups, keyed partitions, commit-after-fanout) is
 what's load-bearing, and the contract tests run identically against
@@ -47,7 +72,7 @@ from realtime_fraud_detection_tpu.stream.transport import (
     Record,
 )
 
-__all__ = ["BrokerServer", "NetBrokerClient"]
+__all__ = ["BrokerServer", "NetBrokerClient", "HaBrokerClient"]
 
 _LEN = struct.Struct(">I")
 _MAX_FRAME = 64 * 1024 * 1024
@@ -91,21 +116,26 @@ class _Handler(socketserver.BaseRequestHandler):
         server: BrokerServer = self.server.outer  # type: ignore[attr-defined]
         sock = self.request
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        while True:
-            try:
-                req = _recv_frame(sock)
-            except (ConnectionError, ValueError, json.JSONDecodeError):
-                return
-            if req is None:
-                return
-            try:
-                resp = server.dispatch(req)
-            except Exception as e:  # noqa: BLE001 - fault isolation per request
-                resp = {"error": f"{type(e).__name__}: {e}"}
-            try:
-                _send_frame(sock, resp)
-            except ConnectionError:
-                return
+        server._conns.add(sock)
+        try:
+            while True:
+                try:
+                    req = _recv_frame(sock)
+                except (ConnectionError, ValueError, json.JSONDecodeError,
+                        OSError):
+                    return
+                if req is None:
+                    return
+                try:
+                    resp = server.dispatch(req)
+                except Exception as e:  # noqa: BLE001 - per-request isolation
+                    resp = {"error": f"{type(e).__name__}: {e}"}
+                try:
+                    _send_frame(sock, resp)
+                except (ConnectionError, OSError):
+                    return
+        finally:
+            server._conns.discard(sock)
 
 
 class _TCPServer(socketserver.ThreadingTCPServer):
@@ -113,14 +143,55 @@ class _TCPServer(socketserver.ThreadingTCPServer):
     daemon_threads = True
 
 
+class _ReplicaLink:
+    """Primary-held connection to one replica server (the shipping lane)."""
+
+    def __init__(self, host: str, port: int, timeout_s: float = 10.0):
+        self.addr = (host, port)
+        self._sock = socket.create_connection((host, port), timeout=timeout_s)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._lock = threading.Lock()
+
+    def call(self, req: Mapping[str, Any]) -> Dict[str, Any]:
+        with self._lock:
+            _send_frame(self._sock, req)
+            resp = _recv_frame(self._sock)
+        if resp is None:
+            raise ConnectionError("replica closed the connection")
+        if "error" in resp:
+            raise RuntimeError(f"replica error: {resp['error']}")
+        return resp
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class NotEnoughReplicasError(RuntimeError):
+    """Produce could not reach min_isr in-sync copies (Kafka's
+    NOT_ENOUGH_REPLICAS). The record may exist on the primary's log but was
+    NOT acked — a retried producer may duplicate it (at-least-once)."""
+
+
 class BrokerServer:
-    """Serve an (optionally durable) partitioned log over TCP."""
+    """Serve an (optionally durable, optionally replicated) partitioned log
+    over TCP. ``role="replica"`` starts read-only; ``min_isr`` counts the
+    primary itself (min_isr=2 means "me plus at least one replica")."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  topics: Sequence[TopicSpec] = TOPIC_SPECS,
-                 log_dir: Optional[str] = None):
+                 log_dir: Optional[str] = None,
+                 role: str = "primary", min_isr: int = 1):
+        if role not in ("primary", "replica"):
+            raise ValueError(f"role must be primary|replica, got {role!r}")
         self.broker = InMemoryBroker(topics)
         self.log_dir = Path(log_dir) if log_dir else None
+        self.role = role
+        self.min_isr = int(min_isr)
+        self._replicas: List[_ReplicaLink] = []
+        self._conns: set = set()          # live handler sockets (for stop())
         self._seg_files: Dict[tuple, Any] = {}
         self._io_lock = threading.Lock()
         if self.log_dir is not None:
@@ -139,7 +210,22 @@ class BrokerServer:
     def stop(self) -> None:
         self._tcp.shutdown()
         self._tcp.server_close()
+        # drop live connections so peers (clients, a primary's replica
+        # link) observe the death immediately — a stopped server must not
+        # keep acking replication traffic from a lingering handler thread
+        for sock in list(self._conns):
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
         with self._io_lock:
+            for link in self._replicas:
+                link.close()
+            self._replicas.clear()
             for f in self._seg_files.values():
                 try:
                     f.close()
@@ -162,34 +248,177 @@ class BrokerServer:
         return f
 
     def _produce(self, topic: str, items: List[tuple]) -> List[Record]:
-        """Produce with WAL-first durability: partition is chosen, the WAL
-        line is written + fsync'd, and only then is the record published to
-        the in-memory log (one fsync per produce call — acks=all). A WAL
-        write failure therefore errors the produce *before* any consumer
-        could see the record; ``_io_lock`` serializes durable produces so
-        WAL line order always matches log offset order per partition.
+        """Produce with WAL-first durability + synchronous replication:
+        partition is chosen, the WAL line is written + fsync'd, the record
+        is published to the in-memory log, and it is shipped to every
+        in-sync replica — the ack happens only once ``min_isr`` copies
+        (self included) hold it. A WAL write failure errors the produce
+        *before* any consumer could see the record; ``_io_lock`` serializes
+        produces so WAL line order always matches log offset order per
+        partition AND replicas receive offsets contiguously.
         ``items``: [(key, value, timestamp|None)].
         """
         b = self.broker
-        if self.log_dir is None:
-            return [b.produce(topic, v, k, ts) for k, v, ts in items]
         with self._io_lock:
             planned = [
                 (b.select_partition(topic, k), k, v,
                  ts if ts is not None else time.time())
                 for k, v, ts in items
             ]
-            touched = set()
-            for part, k, v, ts in planned:
+            if self.log_dir is not None:
+                touched = set()
+                for part, k, v, ts in planned:
+                    f = self._segment(topic, part)
+                    f.write(json.dumps({"k": k, "v": v, "ts": ts},
+                                       separators=(",", ":")) + "\n")
+                    touched.add(f)
+                for f in touched:
+                    f.flush()
+                    os.fsync(f.fileno())
+            recs = [b.append(topic, part, v, k, ts)
+                    for part, k, v, ts in planned]
+            self._replicate(topic, recs)
+            return recs
+
+    # ---------------------------------------------------------- replication
+    def _replicate(self, topic: str, recs: List[Record]) -> None:
+        """Ship freshly appended records to every replica, synchronously.
+        Caller holds ``_io_lock``. A replica that errors is dropped from
+        the ISR; if fewer than ``min_isr`` copies hold the records, the
+        produce fails (the records stay on the local log unacked — a
+        producer retry may duplicate them: at-least-once)."""
+        acks = 1  # self: WAL already fsync'd (or in-memory by configuration)
+        if self._replicas:
+            parts: Dict[int, List[Dict[str, Any]]] = {}
+            for r in recs:
+                parts.setdefault(r.partition, []).append(
+                    {"k": r.key, "v": r.value, "ts": r.timestamp,
+                     "o": r.offset})
+            req = {
+                "op": "replicate", "topic": topic,
+                # partition COUNT rides along: an auto-created topic must
+                # have the same layout on the replica even for partitions
+                # that never received a record, or key routing diverges
+                # after a promote
+                "n_parts": len(self.broker._logs(topic)),
+                "parts": [{"p": p, "base": rows[0]["o"], "records": rows}
+                          for p, rows in parts.items()],
+            }
+            alive = []
+            for link in self._replicas:
+                try:
+                    link.call(req)
+                    acks += 1
+                    alive.append(link)
+                except Exception:  # noqa: BLE001 — ISR shrink on any failure
+                    link.close()
+            self._replicas[:] = alive
+        if acks < self.min_isr:
+            raise NotEnoughReplicasError(
+                f"produce reached {acks} in-sync copies < min_isr "
+                f"{self.min_isr}; record NOT acked")
+
+    def add_replica(self, host: str, port: int,
+                    chunk: int = 500) -> None:
+        """Attach a replica server: sync topic layout, push the record
+        backlog and group offsets, then admit it to the ISR — every later
+        produce ships to it before the producer's ack."""
+        link = _ReplicaLink(host, port)
+        with self._io_lock:
+            b = self.broker
+            for t in list(b._topics):
+                logs = b._logs(t)
+                link.call({"op": "sync_topic", "name": t,
+                           "partitions": len(logs)})
+                rends = link.call({"op": "end_offsets", "topic": t})["ends"]
+                for p, log in enumerate(logs):
+                    start = rends[p] if p < len(rends) else 0
+                    while start < len(log.records):
+                        rows = [
+                            {"k": r.key, "v": r.value, "ts": r.timestamp,
+                             "o": r.offset}
+                            for r in log.records[start:start + chunk]
+                        ]
+                        link.call({"op": "replicate", "topic": t,
+                                   "parts": [{"p": p, "base": rows[0]["o"],
+                                              "records": rows}]})
+                        start += len(rows)
+            link.call({"op": "offsets_sync", "committed": {
+                f"{g}\x00{t}\x00{p}": off
+                for (g, t, p), off in b._committed.items()
+            }})
+            self._replicas.append(link)
+
+    def _apply_replicated(self, topic: str, part: int, base: int,
+                          rows: List[Mapping[str, Any]]) -> None:
+        """Replica side: append shipped records at their primary offsets,
+        WAL-first when durable. Idempotent for already-held offsets; a gap
+        (shipped offset beyond local end) is refused loudly — the primary
+        re-syncs via add_replica rather than silently diverging."""
+        b = self.broker
+        logs = b._logs(topic)
+        if part >= len(logs):
+            with b._lock:
+                while len(logs) < part + 1:
+                    logs.append(type(logs[0])())
+        log = logs[part]
+        with self._io_lock:
+            local_end = len(log.records)
+            fresh = [(base + j, d) for j, d in enumerate(rows)
+                     if base + j >= local_end]
+            if fresh and fresh[0][0] > local_end:
+                raise RuntimeError(
+                    f"replication gap on {topic}-{part}: local end "
+                    f"{local_end}, shipped base {fresh[0][0]}")
+            if self.log_dir is not None and fresh:
                 f = self._segment(topic, part)
-                f.write(json.dumps({"k": k, "v": v, "ts": ts},
-                                   separators=(",", ":")) + "\n")
-                touched.add(f)
-            for f in touched:
+                for _, d in fresh:
+                    f.write(json.dumps(
+                        {"k": d.get("k"), "v": d.get("v"),
+                         "ts": d.get("ts", 0.0)},
+                        separators=(",", ":")) + "\n")
                 f.flush()
                 os.fsync(f.fileno())
-            return [b.append(topic, part, v, k, ts)
-                    for part, k, v, ts in planned]
+            for _, d in fresh:
+                b.append(topic, part, d.get("v"), d.get("k"),
+                         d.get("ts", 0.0))
+
+    def _forward_commit(self, group: str, wire: Mapping[str, Any]) -> None:
+        """Ship an offset commit to replicas so a promoted replica resumes
+        every group where the primary acked it. A failing replica drops
+        from the ISR (same policy as record shipping)."""
+        with self._io_lock:
+            if not self._replicas:
+                return
+            alive = []
+            for link in self._replicas:
+                try:
+                    link.call({"op": "commit_sync", "group": group,
+                               "offsets": dict(wire)})
+                    alive.append(link)
+                except Exception:  # noqa: BLE001
+                    link.close()
+            self._replicas[:] = alive
+
+    def _grow_topic(self, name: str, partitions: int) -> None:
+        """Ensure ``name`` exists with AT LEAST ``partitions`` partitions
+        (replica-side layout sync; partition counts only ever grow)."""
+        b = self.broker
+        b.create_topic(name, partitions)
+        logs = b._logs(name)
+        if len(logs) < partitions:
+            with b._lock:
+                while len(logs) < partitions:
+                    logs.append(type(logs[0])())
+
+    def promote(self) -> None:
+        """Replica -> primary: start accepting writes. The log, offsets and
+        WAL carry over as-is (they were kept in sync by the shipping lane)."""
+        self.role = "primary"
+
+    def isr_size(self) -> int:
+        with self._io_lock:
+            return 1 + len(self._replicas)
 
     def _persist_offsets(self) -> None:
         if self.log_dir is None:
@@ -231,9 +460,49 @@ class BrokerServer:
                 self.broker._committed[(g, t, int(p))] = int(off)
 
     # ------------------------------------------------------------- dispatch
+    _WRITE_OPS = frozenset({"produce", "produce_batch", "commit",
+                            "create_topic"})
+
     def dispatch(self, req: Mapping[str, Any]) -> Dict[str, Any]:
         op = req.get("op")
         b = self.broker
+        if self.role == "replica" and op in self._WRITE_OPS:
+            # reads stay served (a replica is a warm standby + read scale-
+            # out); writes go to the primary or wait for promote()
+            return {"error": "READONLY: replica accepts reads and "
+                             "replication traffic only; promote() to "
+                             "accept writes"}
+        if op == "replicate":
+            n_parts = req.get("n_parts")
+            if n_parts:
+                self._grow_topic(req["topic"], int(n_parts))
+            for blob in req["parts"]:
+                self._apply_replicated(req["topic"], int(blob["p"]),
+                                       int(blob["base"]), blob["records"])
+            return {}
+        if op == "sync_topic":
+            self._grow_topic(req["name"], int(req["partitions"]))
+            return {}
+        if op == "commit_sync":
+            offsets = {}
+            for key, off in req["offsets"].items():
+                t, _, p = key.rpartition(":")
+                offsets[(t, int(p))] = int(off)
+            b.commit(req["group"], offsets)
+            self._persist_offsets()
+            return {}
+        if op == "offsets_sync":
+            for key, off in req["committed"].items():
+                g, t, p = key.split("\x00")
+                b._committed[(g, t, int(p))] = int(off)
+            self._persist_offsets()
+            return {}
+        if op == "promote":
+            self.promote()
+            return {"role": self.role}
+        if op == "status":
+            return {"role": self.role, "min_isr": self.min_isr,
+                    "isr": self.isr_size()}
         if op == "produce":
             rec = self._produce(req["topic"], [(
                 req.get("key"), req["value"], req.get("timestamp"))])[0]
@@ -255,6 +524,7 @@ class BrokerServer:
                 offsets[(t, int(p))] = int(off)
             b.commit(req["group"], offsets)
             self._persist_offsets()
+            self._forward_commit(req["group"], req["offsets"])
             return {}
         if op == "committed":
             return {"offset": b.committed(req["group"], req["topic"],
@@ -267,6 +537,19 @@ class BrokerServer:
             return {"lag": b.lag(req["group"], req["topic"])}
         if op == "create_topic":
             b.create_topic(req["name"], req["partitions"])
+            # layout changes ship to replicas like records do: a topic
+            # created after add_replica must exist with the same partition
+            # count on the survivor, or key routing diverges post-promote
+            with self._io_lock:
+                alive = []
+                for link in self._replicas:
+                    try:
+                        link.call({"op": "sync_topic", "name": req["name"],
+                                   "partitions": req["partitions"]})
+                        alive.append(link)
+                    except Exception:  # noqa: BLE001
+                        link.close()
+                self._replicas[:] = alive
             return {}
         if op == "ping":
             return {"pong": True}
@@ -369,3 +652,74 @@ class NetBrokerClient:
 
     def ping(self) -> bool:
         return bool(self._call({"op": "ping"}).get("pong"))
+
+    def status(self) -> Dict[str, Any]:
+        return self._call({"op": "status"})
+
+    def promote(self) -> Dict[str, Any]:
+        """Remote promote (the ops-script path for failover drills)."""
+        return self._call({"op": "promote"})
+
+
+class HaBrokerClient(NetBrokerClient):
+    """Failover-aware client over an ordered broker list.
+
+    On connection loss or a READONLY response (we were talking to a
+    not-yet-promoted replica) the client rotates to the next address,
+    reconnects, and retries the request. NOTE the produce-retry semantics:
+    a produce whose ack was lost mid-failover may already be on the log,
+    so a retry can duplicate it — at-least-once, exactly like a Kafka
+    acks=all producer retrying across a leader change. Stream consumers
+    dedupe by transaction id (stream/job.py dispatch_batch).
+    """
+
+    def __init__(self, addrs: Sequence[tuple], timeout_s: float = 30.0):
+        if not addrs:
+            raise ValueError("HaBrokerClient needs at least one address")
+        self._addrs = [(str(h), int(p)) for h, p in addrs]
+        self._which = 0
+        self._timeout_s = timeout_s
+        # construction must survive a dead first broker (a process started
+        # AFTER the failover still lists the old primary first): try each
+        # address in order
+        last: Optional[Exception] = None
+        for i, (host, port) in enumerate(self._addrs):
+            try:
+                super().__init__(host=host, port=port, timeout_s=timeout_s)
+                self._which = i
+                return
+            except OSError as e:
+                last = e
+        raise ConnectionError(
+            f"no broker in {self._addrs} reachable: {last}")
+
+    def _rotate(self) -> None:
+        self._which = (self._which + 1) % len(self._addrs)
+        host, port = self._addrs[self._which]
+        with self._lock:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = socket.create_connection(
+                (host, port), timeout=self._timeout_s)
+            self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+    def _call(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        last: Optional[Exception] = None
+        for _ in range(2 * len(self._addrs)):
+            try:
+                return super()._call(req)
+            except RuntimeError as e:
+                if "READONLY" not in str(e):
+                    raise
+                last = e
+            except (ConnectionError, OSError) as e:
+                last = e
+            try:
+                self._rotate()
+            except OSError as e:
+                last = e
+                time.sleep(0.05)
+        raise ConnectionError(
+            f"no broker in {self._addrs} reachable and writable: {last}")

@@ -6,12 +6,18 @@ is what StreamJob depends on, so any future backend (Kafka adapter included)
 must pass this file unchanged.
 """
 
+import os
+import signal
+import subprocess
+import sys
+
 import pytest
 
 from realtime_fraud_detection_tpu.stream import InMemoryBroker
 from realtime_fraud_detection_tpu.stream import topics as T
 from realtime_fraud_detection_tpu.stream.netbroker import (
     BrokerServer,
+    HaBrokerClient,
     NetBrokerClient,
 )
 
@@ -163,3 +169,192 @@ def test_netbroker_keyed_routing_stable_across_restart(tmp_path):
     finally:
         client2.close()
         server2.stop()
+
+
+# ---------------------------------------------------------------------------
+# replication / failover (reference runs RF=3 minISR=2 — create-topics.sh:9-12)
+# ---------------------------------------------------------------------------
+
+
+class TestReplication:
+    def test_sync_replication_and_offset_forwarding(self):
+        """Every acked produce and every commit is on the replica before the
+        client's call returns (min_isr=2 = self + one replica)."""
+        replica = BrokerServer(port=0, role="replica").start()
+        primary = BrokerServer(port=0, min_isr=2).start()
+        primary.add_replica("127.0.0.1", replica.port)
+        client = NetBrokerClient(port=primary.port)
+        rclient = NetBrokerClient(port=replica.port)
+        try:
+            for i in range(40):
+                client.produce(T.TRANSACTIONS, {"n": i}, key=f"u{i % 7}")
+            assert (sum(rclient.end_offsets(T.TRANSACTIONS))
+                    == sum(client.end_offsets(T.TRANSACTIONS)) == 40)
+            # replica holds identical records at identical offsets
+            for p in range(rclient.partitions(T.TRANSACTIONS)):
+                prim = client.read(T.TRANSACTIONS, p, 0, 100)
+                rep = rclient.read(T.TRANSACTIONS, p, 0, 100)
+                assert [(r.offset, r.key, r.value) for r in prim] == \
+                       [(r.offset, r.key, r.value) for r in rep]
+            # offset commits ride the shipping lane too
+            c = client.consumer([T.TRANSACTIONS], "g-rep")
+            c.poll(25)
+            c.commit()
+            for p in range(client.partitions(T.TRANSACTIONS)):
+                assert (rclient.committed("g-rep", T.TRANSACTIONS, p)
+                        == client.committed("g-rep", T.TRANSACTIONS, p))
+        finally:
+            client.close()
+            rclient.close()
+            primary.stop()
+            replica.stop()
+
+    def test_replica_is_readonly_until_promoted(self):
+        replica = BrokerServer(port=0, role="replica").start()
+        rclient = NetBrokerClient(port=replica.port)
+        try:
+            with pytest.raises(RuntimeError, match="READONLY"):
+                rclient.produce(T.TRANSACTIONS, {"n": 1}, key="k")
+            with pytest.raises(RuntimeError, match="READONLY"):
+                rclient.commit("g", {(T.TRANSACTIONS, 0): 1})
+            assert rclient.status()["role"] == "replica"
+            rclient.promote()
+            assert rclient.status()["role"] == "primary"
+            rclient.produce(T.TRANSACTIONS, {"n": 1}, key="k")
+            assert sum(rclient.end_offsets(T.TRANSACTIONS)) == 1
+        finally:
+            rclient.close()
+            replica.stop()
+
+    def test_min_isr_gates_the_ack(self):
+        """min_isr=2 with no replica: produce FAILS (NotEnoughReplicas)
+        rather than pretending durability; attaching a replica heals it;
+        losing the replica breaks it again (ISR shrink)."""
+        primary = BrokerServer(port=0, min_isr=2).start()
+        client = NetBrokerClient(port=primary.port)
+        replica = BrokerServer(port=0, role="replica").start()
+        try:
+            with pytest.raises(RuntimeError, match="NotEnoughReplicas"):
+                client.produce(T.TRANSACTIONS, {"n": 0}, key="k")
+            primary.add_replica("127.0.0.1", replica.port)
+            client.produce(T.TRANSACTIONS, {"n": 1}, key="k")
+            assert primary.isr_size() == 2
+            replica.stop()
+            with pytest.raises(RuntimeError, match="NotEnoughReplicas"):
+                client.produce(T.TRANSACTIONS, {"n": 2}, key="k")
+            assert primary.isr_size() == 1
+        finally:
+            client.close()
+            primary.stop()
+
+    def test_late_replica_catches_up_backlog(self):
+        """add_replica on a primary with history pushes the whole backlog +
+        group offsets before admitting the replica to the ISR."""
+        primary = BrokerServer(port=0).start()
+        client = NetBrokerClient(port=primary.port)
+        for i in range(120):
+            client.produce(T.TRANSACTIONS, {"n": i}, key=f"u{i % 11}")
+        c = client.consumer([T.TRANSACTIONS], "g-late")
+        c.poll(60)
+        c.commit()
+
+        replica = BrokerServer(port=0, role="replica").start()
+        rclient = NetBrokerClient(port=replica.port)
+        try:
+            primary.add_replica("127.0.0.1", replica.port)
+            assert sum(rclient.end_offsets(T.TRANSACTIONS)) == 120
+            for p in range(client.partitions(T.TRANSACTIONS)):
+                assert (rclient.committed("g-late", T.TRANSACTIONS, p)
+                        == client.committed("g-late", T.TRANSACTIONS, p))
+            # and it is IN the ISR: the next produce lands on it too
+            client.produce(T.TRANSACTIONS, {"n": 120}, key="u0")
+            assert sum(rclient.end_offsets(T.TRANSACTIONS)) == 121
+        finally:
+            client.close()
+            rclient.close()
+            primary.stop()
+            replica.stop()
+
+
+_PRIMARY_SCRIPT = """
+import sys, time
+from realtime_fraud_detection_tpu.stream.netbroker import BrokerServer
+log_dir, replica_port = sys.argv[1], int(sys.argv[2])
+s = BrokerServer(port=0, log_dir=log_dir, min_isr=2).start()
+s.add_replica("127.0.0.1", replica_port)
+print(s.port, flush=True)
+while True:
+    time.sleep(1)
+"""
+
+
+class TestKillThePrimary:
+    def test_sigkill_primary_no_acked_record_lost(self, tmp_path):
+        """The drill the state tier already passes (resp.py), now for the
+        data plane: run the primary in a real OS process with min_isr=2,
+        SIGKILL it mid-traffic, promote the replica, and prove every acked
+        record and committed offset survives on the promoted node."""
+        replica = BrokerServer(port=0, role="replica",
+                               log_dir=str(tmp_path / "replica-wal")).start()
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.pathsep.join(
+            [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+            + env.get("PYTHONPATH", "").split(os.pathsep))
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _PRIMARY_SCRIPT,
+             str(tmp_path / "primary-wal"), str(replica.port)],
+            stdout=subprocess.PIPE, text=True, env=env)
+        try:
+            line = proc.stdout.readline().strip()
+            assert line, "primary subprocess died before reporting its port"
+            primary_port = int(line)
+
+            client = HaBrokerClient([("127.0.0.1", primary_port),
+                                     ("127.0.0.1", replica.port)])
+            acked = []
+            for i in range(300):
+                client.produce(T.TRANSACTIONS, {"n": i}, key=f"u{i % 13}")
+                acked.append(i)   # appended only after the min_isr=2 ack
+            c = client.consumer([T.TRANSACTIONS], "g-kill")
+            seen_before = len(c.poll(150))
+            c.commit()
+            committed_before = {
+                p: client.committed("g-kill", T.TRANSACTIONS, p)
+                for p in range(client.partitions(T.TRANSACTIONS))
+            }
+            assert seen_before == 150
+
+            os.kill(proc.pid, signal.SIGKILL)
+            proc.wait(timeout=10)
+            replica.promote()
+
+            # the SAME client keeps working: rotates to the promoted node
+            for i in range(300, 350):
+                client.produce(T.TRANSACTIONS, {"n": i}, key=f"u{i % 13}")
+                acked.append(i)
+
+            # every acked record is present on the survivor
+            survivor = NetBrokerClient(port=replica.port)
+            try:
+                present = set()
+                for p in range(survivor.partitions(T.TRANSACTIONS)):
+                    for r in survivor.read(T.TRANSACTIONS, p, 0, 10_000):
+                        present.add(r.value["n"])
+                missing = [n for n in acked if n not in present]
+                assert not missing, f"acked records lost: {missing[:10]}"
+                # committed group offsets survived the failover
+                for p, off in committed_before.items():
+                    assert survivor.committed("g-kill", T.TRANSACTIONS,
+                                              p) == off
+                # and the group resumes past the committed offsets: together
+                # with the pre-kill reads it covers every acked record
+                c2 = survivor.consumer([T.TRANSACTIONS], "g-kill")
+                rest = c2.poll(10_000)
+                assert len(rest) + seen_before >= len(acked)
+            finally:
+                survivor.close()
+            client.close()
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+            replica.stop()
