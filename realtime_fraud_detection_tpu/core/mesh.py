@@ -11,6 +11,16 @@ parallelism are config choices, not rewrites:
 - ``model`` - tensor-parallel axis, reserved for the BERT encoder
 - ``seq``   - sequence/context-parallel axis for blockwise attention
 
+Multi-host (DCN) story (SURVEY.md §5.8): ``init_distributed`` bootstraps the
+cross-host control plane (``jax.distributed`` — the NCCL/MPI-rendezvous
+analog of the reference's 3-TaskManager Flink cluster,
+docker-compose.yml:287-354), and ``build_multihost_mesh`` lays the global
+mesh out PROCESS-MAJOR along ``data``: the ``model``/``seq`` axes never
+cross a host, so their per-layer all-reduces ride ICI, while the ``data``
+axis's once-per-step gradient all-reduce is the only collective that
+touches DCN — the layering the scaling playbook prescribes. The same
+jitted step runs unchanged; only the mesh construction differs.
+
 Reference parity notes: Flink parallelism=12 over 3 TMs
 (reference docker-compose.yml:265-268) maps to ``data=n_devices`` here.
 """
@@ -67,6 +77,79 @@ def build_mesh(
     shape = config.resolve(len(devices))
     dev_array = np.asarray(devices).reshape(shape)
     return Mesh(dev_array, AXIS_NAMES)
+
+
+def init_distributed(
+    coordinator_address: str,
+    num_processes: int,
+    process_id: int,
+) -> None:
+    """Bootstrap the cross-host (DCN) control plane.
+
+    One call per process, BEFORE any backend use. After it,
+    ``jax.devices()`` is the global device set and ``build_multihost_mesh``
+    lays meshes over all hosts. This is the framework's analog of the
+    reference's TaskManager->JobManager registration
+    (docker-compose.yml:287-354) — except the data plane it unlocks is XLA
+    collectives over DCN, not Akka RPC.
+    """
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def build_multihost_mesh(
+    config: MeshConfig | None = None,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Global (data, model, seq) mesh with a PROCESS-MAJOR data axis.
+
+    Devices are ordered (process_index, id) before reshaping, which pins
+    the physical layout: every ``model`` x ``seq`` tile sits inside one
+    process (so TP/SP collectives — several per layer — stay on ICI), and
+    crossing a ``data``-axis process boundary happens only in the
+    once-per-step DP gradient sync, the one collective cheap enough for
+    DCN. ``model * seq`` must divide the per-process device count or the
+    tile would straddle hosts — refused loudly.
+
+    Single-process: identical to ``build_mesh`` (devices are already one
+    process), so code written against this helper runs unchanged from a
+    dev box to a multi-host pod.
+    """
+    config = config or MeshConfig()
+    devices = list(devices if devices is not None else jax.devices())
+    devices.sort(key=lambda d: (d.process_index, d.id))
+    n_local = min(
+        sum(1 for d in devices if d.process_index == p)
+        for p in {d.process_index for d in devices}
+    )
+    ms = config.model * config.seq
+    if n_local % ms != 0:
+        raise ValueError(
+            f"model*seq={ms} does not divide the per-process device count "
+            f"{n_local}: a TP/SP tile would straddle a host boundary and "
+            f"put per-layer collectives on DCN")
+    shape = config.resolve(len(devices))
+    return Mesh(np.asarray(devices, dtype=object).reshape(shape), AXIS_NAMES)
+
+
+def make_global_batch(mesh: Mesh, tree: Any, shardings: Any) -> Any:
+    """Assemble a global batch from per-process local shards.
+
+    Each process passes the rows it owns (its slice of the data axis);
+    the result is one logical array spanning all hosts. Single-process
+    degrades to a plain sharded device_put. The multi-host twist: hosts
+    never exchange batch bytes — each feeds only its own chips, exactly
+    like the reference's per-TM Kafka partition assignment.
+    """
+    if jax.process_count() == 1:
+        return jax.device_put(tree, shardings)
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.make_array_from_process_local_data(s, np.asarray(x)),
+        tree, shardings,
+    )
 
 
 def local_mesh_size(mesh: Mesh, axis: str = DATA_AXIS) -> int:

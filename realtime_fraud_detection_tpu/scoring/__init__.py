@@ -9,5 +9,6 @@ from realtime_fraud_detection_tpu.scoring.pipeline import (  # noqa: F401
     init_scoring_models,
     make_example_batch,
     score_fused,
+    score_fused_packed,
 )
 from realtime_fraud_detection_tpu.scoring.scorer import FraudScorer  # noqa: F401

@@ -384,3 +384,20 @@ def test_bert_pipeline_encode_matches_sequential():
     want = bert_encode(params, ids, mask, TINY_CONFIG)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_multihost_two_process_step():
+    """The DCN seam (core/mesh.py init_distributed/build_multihost_mesh):
+    the same DP+TP train step runs across a REAL process boundary — two
+    jax.distributed participants with 2 CPU devices each — and its loss
+    matches a single-process evaluation of the identical global batch.
+    Subprocess-based: this test's own 8-device backend is untouched."""
+    import importlib.util
+    from pathlib import Path
+
+    root = Path(__file__).resolve().parent.parent
+    spec = importlib.util.spec_from_file_location(
+        "graft_entry_for_test", root / "__graft_entry__.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    mod._dryrun_multihost(2, 2, timeout_s=300.0)
