@@ -255,6 +255,11 @@ class ScorerConfig:
     node_dim: int = 16         # GNN node feature width
     fanout: int = 16           # GNN neighbor fanout (last-100-txn graph analog)
     text_len: int = 64         # token length for the text branch
+    # "word" = hash-OOV word tokenizer (fast, no vocab file);
+    # "wordpiece" = trained subword vocab with BERT's greedy longest-match
+    # algorithm (models/wordpiece.py — the reference's tokenizer class,
+    # bert_text_analyzer.py:47-66, minus the hub download)
+    tokenizer: str = "word"
     use_pallas: bool = False   # Pallas flash attention (TPU only)
     # start the result's device->host copy at dispatch time so the transfer
     # overlaps the next batch's host work (scorer.dispatch). Tunable because

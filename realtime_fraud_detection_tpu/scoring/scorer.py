@@ -226,9 +226,23 @@ class FraudScorer:
             self.txn_cache = TransactionCache(**cache_kwargs)
         self.history = UserHistoryStore(self.sc.seq_len, self.sc.feature_dim)
         self.graph = EntityGraphStore(self.sc.fanout)
-        self.tokenizer = FraudTokenizer(
-            vocab_size=bert_config.vocab_size, max_length=self.sc.text_len
-        )
+        if self.sc.tokenizer == "wordpiece":
+            from realtime_fraud_detection_tpu.models.wordpiece import (
+                WordPieceTokenizer,
+            )
+
+            self.tokenizer = WordPieceTokenizer(max_length=self.sc.text_len)
+        elif self.sc.tokenizer == "word":
+            self.tokenizer = FraudTokenizer(
+                vocab_size=bert_config.vocab_size,
+                max_length=self.sc.text_len,
+            )
+        else:
+            # a typo'd tokenizer name must not silently feed a text model
+            # ids from the wrong vocabulary
+            raise ValueError(
+                f"ScorerConfig.tokenizer must be 'word' or 'wordpiece', "
+                f"got {self.sc.tokenizer!r}")
         self._users = _EntityIndex(self.sc.node_dim)
         self._merchants = _EntityIndex(self.sc.node_dim)
         self.last_features = np.zeros((0, self.sc.feature_dim), np.float32)

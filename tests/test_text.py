@@ -194,3 +194,92 @@ class TestKeywordVocabCoupling:
             for phrase in group:
                 for word in phrase.split():
                     assert word in tok.vocab, f"{word!r} fell out of the vocab"
+
+
+class TestWordPiece:
+    """models/wordpiece.py: the trained-subword analog of the reference's
+    distilbert-base-uncased tokenizer (bert_text_analyzer.py:47-66)."""
+
+    def test_trainer_learns_frequent_words_as_whole_pieces(self):
+        from realtime_fraud_detection_tpu.models.wordpiece import (
+            train_wordpiece_vocab,
+        )
+
+        vocab = train_wordpiece_vocab(
+            ["crypto exchange wire transfer"] * 50 + ["casino cash out"] * 30,
+            vocab_size=200)
+        for w in ("crypto", "exchange", "wire", "transfer", "casino"):
+            assert w in vocab, f"frequent word {w!r} not a whole piece"
+
+    def test_greedy_longest_match_and_continuations(self):
+        from realtime_fraud_detection_tpu.models.wordpiece import (
+            WordPieceTokenizer,
+        )
+
+        t = WordPieceTokenizer(vocab=["crypto", "pay", "##pay", "c", "##r"],
+                               max_length=16)
+        pieces = t.decode_pieces(t.encode("cryptopay"))
+        assert pieces == ["[CLS]", "crypto", "##pay", "[SEP]"]
+
+    def test_uncoverable_word_becomes_unk_not_crash(self):
+        from realtime_fraud_detection_tpu.models.wordpiece import (
+            WordPieceTokenizer,
+        )
+
+        t = WordPieceTokenizer(vocab=["abc"], max_length=16)
+        pieces = t.decode_pieces(t.encode("abc zzz"))
+        assert pieces == ["[CLS]", "abc", "[UNK]", "[SEP]"]
+
+    def test_committed_domain_vocab_loads_and_covers_fraud_terms(self):
+        from realtime_fraud_detection_tpu.models.wordpiece import (
+            WordPieceTokenizer,
+        )
+
+        t = WordPieceTokenizer(max_length=32)   # committed vocab file
+        assert t.vocab_size > 1500
+        # the planted suspicious-merchant tokens (sim/simulator.py) must
+        # tokenize to whole pieces — this is the signal the text branch
+        # learns from
+        for term in ("crypto", "exchange", "gift", "card", "wire",
+                     "transfer", "casino"):
+            ids = t.encode(term)
+            assert len(ids) == 3, f"{term!r} -> {t.decode_pieces(ids)}"
+
+    def test_encode_batch_shapes_and_special_ids(self):
+        import numpy as np
+
+        from realtime_fraud_detection_tpu.models.tokenizer import (
+            CLS_ID,
+            PAD_ID,
+            SEP_ID,
+        )
+        from realtime_fraud_detection_tpu.models.wordpiece import (
+            WordPieceTokenizer,
+        )
+
+        t = WordPieceTokenizer(max_length=12)
+        ids, mask = t.encode_batch(["crypto exchange", ""])
+        assert ids.shape == (2, 12) and mask.shape == (2, 12)
+        assert ids.dtype == np.int32
+        assert ids[0, 0] == CLS_ID
+        assert SEP_ID in ids[0]
+        assert ids[1, 2] == PAD_ID and not mask[1, 2]
+
+    def test_scorer_uses_wordpiece_by_config(self):
+        from realtime_fraud_detection_tpu.models.wordpiece import (
+            WordPieceTokenizer,
+        )
+        from realtime_fraud_detection_tpu.scoring import (
+            FraudScorer,
+            ScorerConfig,
+        )
+        from realtime_fraud_detection_tpu.sim.simulator import (
+            TransactionGenerator,
+        )
+
+        gen = TransactionGenerator(num_users=16, num_merchants=8, seed=1)
+        scorer = FraudScorer(
+            scorer_config=ScorerConfig(text_len=32, tokenizer="wordpiece"))
+        assert isinstance(scorer.tokenizer, WordPieceTokenizer)
+        results = scorer.score_batch(gen.generate_batch(4))
+        assert len(results) == 4
