@@ -1087,7 +1087,10 @@ def _e2e_soak(result: dict, models, sc, bert_config, use_pallas: bool,
             "recall": round(tp / max(n_pos, 1), 4),
             "blend": "trees+iforest trained on streamed features; "
                      "untrained neural branches execute on device but "
-                     "are blend-masked (per-branch validity, §2.2)",
+                     "are blend-masked (per-branch validity, §2.2). The "
+                     "full ≥3-branch blend decision + per-branch "
+                     "ablations: QUALITY_r05.json (rtfd quality-eval, "
+                     "training/blend_eval.py protocol)",
             "reference_claim": "96.8% accuracy, unmeasured "
                                "(reference README.md:203)",
         }

@@ -463,13 +463,26 @@ def cmd_broker(args: argparse.Namespace) -> int:
     multi-service deployment; stream/netbroker.py). Blocks until SIGINT."""
     from realtime_fraud_detection_tpu.stream.netbroker import BrokerServer
 
+    import time as _time
+
     server = BrokerServer(host=args.host, port=args.port,
                           log_dir=args.log_dir or None,
                           role=getattr(args, "role", "primary"),
                           min_isr=getattr(args, "min_isr", 1)).start()
     for addr in getattr(args, "replica", []) or []:
         rhost, _, rport = addr.rpartition(":")
-        server.add_replica(rhost or "127.0.0.1", int(rport))
+        # a cluster starting in parallel may bring the primary up first:
+        # retry attachment until the replica answers (k8s data-plane.yaml)
+        for attempt in range(60):
+            try:
+                server.add_replica(rhost or "127.0.0.1", int(rport))
+                break
+            except OSError as e:
+                if attempt == 59:
+                    raise
+                print(f"replica {addr} not reachable yet ({e}); retrying",
+                      file=sys.stderr)
+                _time.sleep(2.0)
         print(f"replica {addr} caught up and in sync", file=sys.stderr)
     print(f"broker listening on {args.host}:{server.port}"
           + (f" (log_dir={args.log_dir})" if args.log_dir else "")
@@ -510,6 +523,138 @@ def threading_event_wait() -> None:  # pragma: no cover - blocks forever
     import threading
 
     threading.Event().wait()
+
+
+def cmd_quality_eval(args: argparse.Namespace) -> int:
+    """Run the production blend-selection protocol (training/blend_eval.py):
+    train all 5 branches on a stream-matched segment, admit branches into
+    the blend by validation A/B, report held-out quality + ablations. The
+    committed QUALITY_r*.json artifacts are produced by exactly this
+    command."""
+    import dataclasses as _dc
+
+    from realtime_fraud_detection_tpu.training.blend_eval import (
+        BlendEvalConfig,
+        run_blend_eval,
+    )
+
+    cfg = _dc.replace(
+        BlendEvalConfig(), seed=args.seed,
+        train_batches=args.train_batches, val_batches=args.val_batches,
+        test_batches=args.test_batches)
+    result = run_blend_eval(
+        cfg, log=lambda m: print(f"[quality-eval] {m}", file=sys.stderr,
+                                 flush=True))
+    payload = json.dumps(result, indent=2)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(payload + "\n")
+        print(f"wrote {args.output}", file=sys.stderr)
+    else:
+        print(payload)
+    return 0
+
+
+def cmd_alert_router(args: argparse.Namespace) -> int:
+    """Fan fraud alerts out to notification receivers.
+
+    The reference routes high-risk events EventBridge -> Lambda -> SNS
+    (fraud-detection-additional-resources.yaml:364-458: the Lambda just
+    reshapes the event and publishes it). Here the same seam is a consumer
+    on the ``fraud-alerts`` topic that POSTs each alert to an
+    Alertmanager-compatible webhook (deploy/monitoring/alertmanager.yml
+    owns the receiver fan-out: email/page/chat — the SNS-subscription
+    analog), or prints JSON lines when no webhook is configured (log
+    sink). ``--once`` drains and exits (the CronJob/test mode); default
+    follows the topic forever.
+    """
+    import time as _time
+    import urllib.request
+
+    from realtime_fraud_detection_tpu.stream import (
+        HaBrokerClient,
+        NetBrokerClient,
+    )
+    from realtime_fraud_detection_tpu.stream import topics as T
+
+    # comma-separated addresses = failover list (HaBrokerClient rotates on
+    # connection loss / a replica's READONLY); a single address keeps the
+    # plain client
+    addrs = [_addr(a, 9092) for a in args.broker.split(",") if a.strip()]
+    if len(addrs) > 1:
+        broker = HaBrokerClient(addrs)
+    else:
+        broker = NetBrokerClient(host=addrs[0][0], port=addrs[0][1])
+    consumer = broker.consumer([T.ALERTS], args.group)
+    routed = 0
+    backoff = 1.0
+    try:
+        while True:
+            recs = consumer.poll(500)
+            if not recs:
+                if args.once:
+                    break
+                _time.sleep(args.poll_interval)
+                continue
+            payload = []
+            for r in recs:
+                a = r.value if isinstance(r.value, dict) else {}
+                payload.append({
+                    "labels": {
+                        "alertname": str(a.get("alert_type",
+                                               "FRAUD_DETECTED")),
+                        "severity": ("critical"
+                                     if str(a.get("decision")) == "DECLINE"
+                                     else "warning"),
+                        "risk_level": str(a.get("risk_level", "UNKNOWN")),
+                        "merchant_id": str(a.get("merchant_id", "")),
+                        "service": "rtfd",
+                    },
+                    "annotations": {
+                        "transaction_id": str(a.get("transaction_id", "")),
+                        "user_id": str(a.get("user_id", "")),
+                        "amount": str(a.get("amount", "")),
+                        "fraud_score": str(a.get("fraud_score", "")),
+                    },
+                })
+            if args.webhook:
+                req = urllib.request.Request(
+                    args.webhook, data=json.dumps(payload).encode(),
+                    headers={"Content-Type": "application/json"},
+                    method="POST")
+                try:
+                    with urllib.request.urlopen(req, timeout=10) as resp:
+                        resp.read()
+                except OSError as e:  # URLError subclasses OSError
+                    # a receiver blip must not crash-loop the daemon:
+                    # leave offsets uncommitted (the batch redelivers),
+                    # back off, retry. --once propagates the failure so
+                    # CronJob/test mode stays loud.
+                    if args.once:
+                        raise
+                    print(f"webhook unreachable ({e}); retrying in "
+                          f"{backoff:.0f}s", file=sys.stderr)
+                    _time.sleep(backoff)
+                    backoff = min(backoff * 2, 60.0)
+                    # rewind to the committed offsets (the crash-recovery
+                    # path) so the uncommitted batch redelivers
+                    consumer.seek_to_committed()
+                    continue
+            else:
+                for item in payload:
+                    print(json.dumps(item), flush=True)
+            backoff = 1.0
+            # commit only after the receiver accepted the batch:
+            # at-least-once alert delivery (receivers dedupe on
+            # transaction_id, same contract as the predictions topic)
+            consumer.commit()
+            routed += len(payload)
+    except KeyboardInterrupt:  # pragma: no cover - operator stop
+        pass
+    finally:
+        broker.close()
+    print(f"routed {routed} alerts", file=sys.stderr)
+    return 0
 
 
 def cmd_health_check(args: argparse.Namespace) -> int:
@@ -647,6 +792,48 @@ def build_parser() -> argparse.ArgumentParser:
                     help="attach a running replica server (repeatable); "
                          "each is caught up then joins the ISR")
     sp.set_defaults(fn=cmd_broker)
+
+    import dataclasses as _dcs
+    from types import SimpleNamespace as _NS
+
+    from realtime_fraud_detection_tpu.training.blend_eval import (
+        BlendEvalConfig as _BLEND_DEFAULTS_CLS,
+    )
+
+    # read field defaults WITHOUT instantiating (the bert default factory
+    # would pull jax into every CLI invocation's parser build)
+    _BLEND_DEFAULTS = _NS(**{
+        f.name: f.default for f in _dcs.fields(_BLEND_DEFAULTS_CLS)
+        if f.default is not _dcs.MISSING
+    })
+    sp = sub.add_parser("quality-eval",
+                        help="run the blend-selection quality protocol")
+    sp.add_argument("--output", default="",
+                    help="write the evidence JSON here (default stdout)")
+    sp.add_argument("--seed", type=int, default=3)
+    # defaults mirror BlendEvalConfig exactly — the CLI and the Python
+    # entry must make identical admission decisions
+    sp.add_argument("--train-batches", type=int,
+                    default=_BLEND_DEFAULTS.train_batches)
+    sp.add_argument("--val-batches", type=int,
+                    default=_BLEND_DEFAULTS.val_batches)
+    sp.add_argument("--test-batches", type=int,
+                    default=_BLEND_DEFAULTS.test_batches)
+    sp.set_defaults(fn=cmd_quality_eval)
+
+    sp = sub.add_parser("alert-router",
+                        help="fan fraud alerts out to notification receivers")
+    sp.add_argument("--broker", default="127.0.0.1:9092",
+                    help="broker host:port to consume fraud-alerts from")
+    sp.add_argument("--webhook", default="",
+                    help="Alertmanager /api/v2/alerts URL "
+                         "(empty = JSON lines on stdout)")
+    sp.add_argument("--group", default="alert-router",
+                    help="consumer group (offset checkpointing)")
+    sp.add_argument("--once", action="store_true",
+                    help="drain the topic and exit (CronJob/test mode)")
+    sp.add_argument("--poll-interval", type=float, default=1.0)
+    sp.set_defaults(fn=cmd_alert_router)
 
     sp = sub.add_parser("state-server",
                         help="run the shared state server (Redis protocol)")

@@ -1,0 +1,75 @@
+"""Post-training probability calibration, folded into model parameters.
+
+Class-weighted training (the imbalance fix that lifted the LSTM branch from
+0.74 to ~0.97 AUC) deliberately shifts each branch's operating point: a
+pos_weight of ~16 inflates predicted probabilities by roughly that factor
+in odds space. That is fine for a branch alone (ranking is unchanged) but
+poisons the ENSEMBLE, whose serving combine is a weighted average of raw
+probabilities (ensemble/combine.py:114-117): an uncalibrated branch's
+inflated scores drag every blend they join. The fix is Platt scaling —
+fit ``sigmoid(a * z + b)`` on held-out validation logits — and because
+every neural branch ends in a plain affine head, (a, b) FOLDS INTO THE
+EXISTING PARAMETERS: scale the final weight matrix by ``a`` and shift the
+bias. No new serving op, no wrapper — the calibrated model is just a model,
+and the fused device program runs it unchanged.
+
+Used by training/blend_eval.py before blend admission; the fold functions
+are pinned exact by tests/test_blend_eval.py.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import numpy as np
+
+__all__ = ["platt_fit", "platt_apply", "calibrate_lstm_head",
+           "calibrate_gnn_head", "calibrate_bert_head"]
+
+
+def platt_fit(logits: np.ndarray, labels: np.ndarray,
+              iters: int = 500, lr: float = 0.1) -> Tuple[float, float]:
+    """Fit (a, b) of ``p = sigmoid(a*z + b)`` by BCE gradient descent on
+    held-out logits. Deterministic, initialized at identity (a=1, b=0)."""
+    z = np.asarray(logits, np.float64)
+    y = np.asarray(labels, np.float64)
+    a, b = 1.0, 0.0
+    for _ in range(iters):
+        p = 1.0 / (1.0 + np.exp(-(a * z + b)))
+        g = p - y
+        a -= lr * float((g * z).mean())
+        b -= lr * float(g.mean())
+    return float(a), float(b)
+
+
+def platt_apply(logits: np.ndarray, a: float, b: float) -> np.ndarray:
+    return 1.0 / (1.0 + np.exp(-(a * np.asarray(logits, np.float64) + b)))
+
+
+def calibrate_lstm_head(params: Dict[str, jax.Array], a: float,
+                        b: float) -> Dict[str, jax.Array]:
+    """Fold (a, b) into the LSTM's final dense (models/lstm.py w_head2):
+    z' = a*z + b exactly, so ``sigmoid(lstm_logits(calibrated, x))`` IS the
+    Platt-calibrated probability."""
+    return {**params,
+            "w_head2": params["w_head2"] * a,
+            "b_head2": params["b_head2"] * a + b}
+
+
+def calibrate_gnn_head(params: Dict[str, jax.Array], a: float,
+                       b: float) -> Dict[str, jax.Array]:
+    """Same fold for the GraphSAGE head (models/gnn.py w_head2)."""
+    return {**params,
+            "w_head2": params["w_head2"] * a,
+            "b_head2": params["b_head2"] * a + b}
+
+
+def calibrate_bert_head(params: Dict, a: float, b: float) -> Dict:
+    """Fold into the 2-logit classifier (models/bert.py): the branch score
+    is ``z = logit[1] - logit[0]``; scaling both columns by ``a`` and
+    adding ``b`` to class 1's bias gives z' = a*z + b exactly."""
+    clf = params["classifier"]
+    new_b = clf["b"] * a
+    new_b = new_b.at[1].add(b)
+    return {**params, "classifier": {"w": clf["w"] * a, "b": new_b}}

@@ -1,0 +1,160 @@
+"""Blend-selection protocol (training/blend_eval.py): the quality evidence
+behind the production model_valid/weights setting. Tiny sizes — the full
+protocol is the committed QUALITY_r05.json (rtfd quality-eval)."""
+
+import numpy as np
+import pytest
+
+from realtime_fraud_detection_tpu.models.bert import BertConfig
+from realtime_fraud_detection_tpu.training.blend_eval import (
+    BlendEvalConfig,
+    _auc,
+    _blend_fn,
+    run_blend_eval,
+)
+
+
+def _tiny_cfg() -> BlendEvalConfig:
+    return BlendEvalConfig(
+        num_users=300, num_merchants=100, seed=5, batch_size=128,
+        train_batches=10, val_batches=3, test_batches=5,
+        n_trees=10, tree_depth=4, iforest_trees=20,
+        lstm_epochs=2, text_epochs=1, gnn_epochs=1, text_len=16,
+        bert=BertConfig(hidden_size=32, num_layers=1, num_heads=2,
+                        intermediate_size=64),
+        bootstrap=50,
+    )
+
+
+def test_auc_known_answer():
+    y = np.array([0, 0, 1, 1], np.float32)
+    assert _auc(y, np.array([0.1, 0.2, 0.8, 0.9])) == 1.0
+    assert _auc(y, np.array([0.9, 0.8, 0.2, 0.1])) == 0.0
+    assert _auc(y, np.array([0.5, 0.5, 0.5, 0.5])) == pytest.approx(0.5)
+
+
+def test_blend_fn_matches_manual_weighted_average():
+    """Serving parity: _blend_fn must equal the renormalized weighted
+    average the device combine computes over the valid branch set."""
+    rng = np.random.default_rng(0)
+    n = 50
+    scores = {"xgboost_primary": rng.random(n).astype(np.float32),
+              "lstm_sequential": rng.random(n).astype(np.float32)}
+    w = {"xgboost_primary": 0.3, "lstm_sequential": 0.25}
+    got = _blend_fn(w)(scores)
+    want = (0.3 * scores["xgboost_primary"] + 0.25 * scores["lstm_sequential"]) / 0.55
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_protocol_end_to_end_tiny():
+    result = run_blend_eval(_tiny_cfg())
+    # structural contract the artifact consumers rely on
+    assert set(result["branch_auc"]) == {
+        "xgboost_primary", "isolation_forest", "lstm_sequential", "bert_text",
+        "graph_neural"}
+    # baseline pair is always in the selected blend; admission is gated
+    assert {"xgboost_primary", "isolation_forest"} <= set(
+        result["selected_blend"]["branches"])
+    assert len(result["admission"]) == 3     # every other branch got a trial
+    for a in result["admission"]:
+        # the gate: an accepted branch must not have regressed on val
+        if a["accepted"]:
+            assert a["val_auc_with"] >= a["val_auc_before"]
+    # trees must carry real signal even at tiny sizes
+    assert result["branch_auc"]["xgboost_primary"]["test"] > 0.8
+    t = result["test"]
+    assert t["blend_auc"] == pytest.approx(
+        t["baseline_pair_auc"] + t["delta_auc"], abs=1e-3)
+    lo, hi = t["delta_auc_bootstrap_95ci"]
+    assert lo <= hi
+    ops = result["operating_points"]
+    assert 0 <= ops["at_0.5"]["recall"] <= 1
+
+
+class TestCalibrationFold:
+    """training/calibrate.py: the Platt fold must be EXACT — the calibrated
+    model's own forward pass produces sigmoid(a*z+b)."""
+
+    def test_platt_fit_recovers_shift(self):
+        from realtime_fraud_detection_tpu.training.calibrate import (
+            platt_apply,
+            platt_fit,
+        )
+
+        rng = np.random.default_rng(0)
+        z = rng.normal(0, 2, 4000)
+        # true generative model: p = sigmoid(0.8 z - 1.2)
+        y = (rng.random(4000) < 1 / (1 + np.exp(-(0.8 * z - 1.2)))).astype(
+            np.float32)
+        a, b = platt_fit(z, y)
+        assert a == pytest.approx(0.8, abs=0.15)
+        assert b == pytest.approx(-1.2, abs=0.2)
+        p = platt_apply(z, a, b)
+        assert 0 < p.min() and p.max() < 1
+
+    def test_lstm_fold_exact(self):
+        import jax
+
+        from realtime_fraud_detection_tpu.models.lstm import (
+            init_lstm_params,
+            lstm_logits,
+        )
+        from realtime_fraud_detection_tpu.training.calibrate import (
+            calibrate_lstm_head,
+        )
+
+        p = init_lstm_params(jax.random.PRNGKey(0), 8, 16, head_hidden=8)
+        x = np.random.default_rng(1).normal(0, 1, (5, 3, 8)).astype(
+            np.float32)
+        z = np.asarray(lstm_logits(p, x))
+        z2 = np.asarray(lstm_logits(calibrate_lstm_head(p, 0.7, -0.4), x))
+        np.testing.assert_allclose(z2, 0.7 * z - 0.4, rtol=2e-3, atol=2e-3)
+
+    def test_gnn_fold_exact(self):
+        import jax
+
+        from realtime_fraud_detection_tpu.models.gnn import (
+            gnn_logits,
+            init_gnn_params,
+        )
+        from realtime_fraud_detection_tpu.training.calibrate import (
+            calibrate_gnn_head,
+        )
+
+        rng = np.random.default_rng(2)
+        p = init_gnn_params(jax.random.PRNGKey(0), 4, 8, 8, head_hidden=8)
+        args = (rng.normal(0, 1, (5, 8)).astype(np.float32),
+                rng.normal(0, 1, (5, 4)).astype(np.float32),
+                rng.normal(0, 1, (5, 4)).astype(np.float32),
+                rng.normal(0, 1, (5, 3, 4)).astype(np.float32),
+                np.ones((5, 3), bool),
+                rng.normal(0, 1, (5, 3, 4)).astype(np.float32),
+                np.ones((5, 3), bool))
+        z = np.asarray(gnn_logits(p, *args))
+        z2 = np.asarray(gnn_logits(calibrate_gnn_head(p, 1.3, 0.25), *args))
+        np.testing.assert_allclose(z2, 1.3 * z + 0.25, rtol=2e-3, atol=2e-3)
+
+    def test_bert_fold_exact(self):
+        import jax
+
+        from realtime_fraud_detection_tpu.models.bert import (
+            BertConfig,
+            bert_logits,
+            init_bert_params,
+        )
+        from realtime_fraud_detection_tpu.training.calibrate import (
+            calibrate_bert_head,
+        )
+
+        cfg = BertConfig(hidden_size=32, num_layers=1, num_heads=2,
+                         intermediate_size=64)
+        p = init_bert_params(jax.random.PRNGKey(0), cfg)
+        rng = np.random.default_rng(3)
+        ids = rng.integers(0, 1000, (4, 10)).astype(np.int32)
+        mask = np.ones((4, 10), bool)
+        lg = np.asarray(bert_logits(p, ids, mask, cfg))
+        z = lg[:, 1] - lg[:, 0]
+        lg2 = np.asarray(bert_logits(
+            calibrate_bert_head(p, 0.6, 0.9), ids, mask, cfg))
+        z2 = lg2[:, 1] - lg2[:, 0]
+        np.testing.assert_allclose(z2, 0.6 * z + 0.9, rtol=2e-3, atol=2e-3)
