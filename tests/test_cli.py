@@ -113,3 +113,125 @@ class TestAucTies:
         # label orders (ordinal ranks would give 1.0 / 0.0)
         assert _auc(np.array([0.0, 1.0]), np.array([0.5, 0.5])) == 0.5
         assert _auc(np.array([1.0, 0.0]), np.array([0.5, 0.5])) == 0.5
+
+
+class TestAlertRouter:
+    def test_routes_alerts_to_webhook_with_committed_offsets(self):
+        """cli alert-router: the EventBridge->Lambda->SNS analog — consumes
+        fraud-alerts, POSTs Alertmanager-v2 payloads to the webhook, commits
+        offsets only after the receiver accepts (at-least-once)."""
+        import http.server
+        import json as _json
+        import threading
+
+        from realtime_fraud_detection_tpu.stream import topics as T
+        from realtime_fraud_detection_tpu.stream.netbroker import (
+            BrokerServer,
+            NetBrokerClient,
+        )
+
+        received = []
+
+        class Hook(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):
+                body = self.rfile.read(int(self.headers["Content-Length"]))
+                received.extend(_json.loads(body))
+                self.send_response(200)
+                self.end_headers()
+
+            def log_message(self, *a):
+                pass
+
+        hook = http.server.HTTPServer(("127.0.0.1", 0), Hook)
+        threading.Thread(target=hook.serve_forever, daemon=True).start()
+        broker = BrokerServer(port=0).start()
+        client = NetBrokerClient(port=broker.port)
+        try:
+            for i in range(5):
+                client.produce(T.ALERTS, {
+                    "alert_type": "FRAUD_DETECTED",
+                    "transaction_id": f"t{i}",
+                    "user_id": f"u{i}",
+                    "amount": 100.0 + i,
+                    "fraud_score": 0.9,
+                    "risk_level": "HIGH",
+                    "decision": "DECLINE" if i % 2 else "REVIEW",
+                }, key=f"u{i}")
+            rc = main([
+                "alert-router", "--broker", f"127.0.0.1:{broker.port}",
+                "--webhook",
+                f"http://127.0.0.1:{hook.server_address[1]}/api/v2/alerts",
+                "--once"])
+            assert rc == 0
+            assert len(received) == 5
+            assert {r["annotations"]["transaction_id"]
+                    for r in received} == {f"t{i}" for i in range(5)}
+            assert all(r["labels"]["alertname"] == "FRAUD_DETECTED"
+                       for r in received)
+            sev = {r["annotations"]["transaction_id"]: r["labels"]["severity"]
+                   for r in received}
+            assert sev["t1"] == "critical" and sev["t0"] == "warning"
+            # offsets committed: a re-run routes nothing new
+            received.clear()
+            rc = main([
+                "alert-router", "--broker", f"127.0.0.1:{broker.port}",
+                "--webhook",
+                f"http://127.0.0.1:{hook.server_address[1]}/api/v2/alerts",
+                "--once"])
+            assert rc == 0 and received == []
+        finally:
+            client.close()
+            broker.stop()
+            hook.shutdown()
+
+    def test_comma_broker_list_fails_over_dead_first_address(self):
+        """--broker with a comma list builds an HaBrokerClient: a dead
+        first address (the killed primary) must not stop the router."""
+        import http.server
+        import json as _json
+        import socket
+        import threading
+
+        from realtime_fraud_detection_tpu.stream import topics as T
+        from realtime_fraud_detection_tpu.stream.netbroker import (
+            BrokerServer,
+            NetBrokerClient,
+        )
+
+        received = []
+
+        class Hook(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):
+                body = self.rfile.read(int(self.headers["Content-Length"]))
+                received.extend(_json.loads(body))
+                self.send_response(200)
+                self.end_headers()
+
+            def log_message(self, *a):
+                pass
+
+        hook = http.server.HTTPServer(("127.0.0.1", 0), Hook)
+        threading.Thread(target=hook.serve_forever, daemon=True).start()
+        with socket.socket() as s:           # a port nobody listens on
+            s.bind(("127.0.0.1", 0))
+            dead_port = s.getsockname()[1]
+        broker = BrokerServer(port=0).start()
+        client = NetBrokerClient(port=broker.port)
+        try:
+            client.produce(T.ALERTS, {
+                "alert_type": "FRAUD_DETECTED", "transaction_id": "tx",
+                "user_id": "u", "amount": 9.0, "fraud_score": 0.95,
+                "risk_level": "HIGH", "decision": "DECLINE"}, key="u")
+            rc = main([
+                "alert-router",
+                "--broker", f"127.0.0.1:{dead_port},127.0.0.1:{broker.port}",
+                "--webhook",
+                f"http://127.0.0.1:{hook.server_address[1]}/alerts",
+                "--once"])
+            assert rc == 0
+            assert [r["annotations"]["transaction_id"]
+                    for r in received] == ["tx"]
+        finally:
+            client.close()
+            broker.stop()
+            hook.shutdown()
