@@ -220,6 +220,10 @@ def cmd_serve(args: argparse.Namespace) -> int:
         config.serving.host = args.host
     if args.port is not None:
         config.serving.port = args.port
+    if getattr(args, "quality_artifact", ""):
+        applied = config.apply_quality_artifact(args.quality_artifact)
+        print(f"serving the measured blend from {args.quality_artifact}: "
+              f"{applied}", file=sys.stderr)
     scorer = None
     state_addr = args.state or os.environ.get("RTFD_STATE_ADDR", "")
     if state_addr:
@@ -752,6 +756,10 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--config", default="", help="JSON config file")
     sp.add_argument("--checkpoint-dir", default="",
                     help="restore model params (e.g. from `train`) at startup")
+    sp.add_argument("--quality-artifact", default="",
+                    help="deploy the measured blend from a quality-eval "
+                         "JSON (e.g. QUALITY_r05.json): enabled branches "
+                         "+ weights become the artifact's selected_blend")
     sp.set_defaults(fn=cmd_serve)
 
     sp = sub.add_parser("train", help="train tree models on synthetic data")

@@ -49,7 +49,10 @@ def sanitize_for_stream(body: Any) -> Tuple[Dict[str, Any], List[str]]:
         if f in txn:
             try:
                 v = int(txn[f])
-            except (TypeError, ValueError):
+            except (TypeError, ValueError, OverflowError):
+                # OverflowError: int(float('inf')) — found by the ingest
+                # fuzz test; an infinite hour/day field drops like any
+                # other uncoercible value
                 del txn[f]
                 continue
             if lo <= v <= hi:

@@ -54,23 +54,51 @@ def train_bert(
     epochs: int = 2,
     learning_rate: float = 5e-5,
     seed: int = 0,
+    pos_weight: float | None = None,
+    calibrate: bool = True,
 ) -> Dict:
-    """Fine-tune (from random init) the classifier on stream text."""
-    from realtime_fraud_detection_tpu.training.neural import NeuralTrainer
+    """Fine-tune (from random init) the classifier on stream text.
+    ``pos_weight=None`` = auto class weighting (neg/pos ratio; fraud is ~5%
+    of the stream); ``calibrate`` folds a tail-fitted Platt transform into
+    the classifier head — see training/neural.py weighted_bce_loss and
+    training/calibrate.py for why weighted branches must be calibrated
+    before the serving ensemble averages their probabilities."""
+    from realtime_fraud_detection_tpu.training.neural import (
+        NeuralTrainer,
+        _calibration_split,
+        auto_pos_weight,
+    )
 
     config = config or BertConfig()
     ids, mask, labels = build_text_dataset(generator, n_transactions, max_length)
+    n_cal = _calibration_split(len(labels)) if calibrate else 0
+    tr_sl = slice(0, len(labels) - n_cal)
     params = init_bert_params(jax.random.PRNGKey(seed), config)
+    pw = (auto_pos_weight(labels[tr_sl]) if pos_weight is None
+          else float(pos_weight))
 
     def loss_fn(p, inputs, by):
         bi, bm = inputs
         logits = bert_logits(p, bi, bm, config)
-        return optax.softmax_cross_entropy_with_integer_labels(
+        per = optax.softmax_cross_entropy_with_integer_labels(
             logits, by.astype(jnp.int32)
-        ).mean()
+        )
+        return (per * jnp.where(by > 0.5, pw, 1.0)).mean()
 
     trainer = NeuralTrainer(
         batch_size=batch_size, epochs=epochs, seed=seed,
         optimizer=optax.adamw(learning_rate),
     )
-    return trainer.train(params, loss_fn, (ids, mask), labels)
+    params = trainer.train(params, loss_fn, (ids[tr_sl], mask[tr_sl]),
+                           labels[tr_sl])
+    if n_cal and 0 < labels[-n_cal:].sum() < n_cal:
+        from realtime_fraud_detection_tpu.training.calibrate import (
+            calibrate_bert_head,
+            platt_fit,
+        )
+
+        lg = np.asarray(bert_logits(params, ids[-n_cal:], mask[-n_cal:],
+                                    config))
+        a, b = platt_fit(lg[:, 1] - lg[:, 0], labels[-n_cal:])
+        params = calibrate_bert_head(params, a, b)
+    return params

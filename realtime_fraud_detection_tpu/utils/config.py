@@ -302,6 +302,38 @@ class Config:
         if model_name in self.models:
             self.models[model_name].enabled = True
 
+    def apply_quality_artifact(self, artifact_path: str) -> Dict[str, float]:
+        """Deploy a measured blend: set enabled models + weights from a
+        quality-eval artifact (`rtfd quality-eval` / QUALITY_r*.json).
+
+        This closes the loop from measurement to serving: the artifact's
+        ``selected_blend`` — the branch set that survived the validation
+        A/B gate, at its admitted weights — becomes this config's model
+        table, so the scorer's validity mask and the device combine's
+        weights are exactly what the protocol measured. Branches outside
+        the blend stay configured but disabled (hot-enable later via
+        /reload-models + enable_model without a recompile). Returns the
+        applied weights."""
+        with open(artifact_path) as f:
+            artifact = json.load(f)
+        weights = artifact.get("selected_blend", {}).get("weights", {})
+        if not weights:
+            raise ValueError(
+                f"{artifact_path} has no selected_blend.weights — not a "
+                f"quality-eval artifact?")
+        unknown = [n for n in weights if n not in self.models]
+        if unknown:
+            raise ValueError(
+                f"artifact names unknown model(s) {unknown}; "
+                f"configured: {sorted(self.models)}")
+        for name, mc in self.models.items():
+            if name in weights:
+                mc.enabled = True
+                mc.weight = float(weights[name])
+            else:
+                mc.enabled = False
+        return {n: float(w) for n, w in weights.items()}
+
     # -- serialization -----------------------------------------------------
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)

@@ -158,3 +158,63 @@ class TestCalibrationFold:
             calibrate_bert_head(p, 0.6, 0.9), ids, mask, cfg))
         z2 = lg2[:, 1] - lg2[:, 0]
         np.testing.assert_allclose(z2, 0.6 * z + 0.9, rtol=2e-3, atol=2e-3)
+
+
+class TestDeployMeasuredBlend:
+    """Config.apply_quality_artifact: the loop from measurement to serving
+    — the artifact's selected_blend becomes the config's model table."""
+
+    def test_applies_selected_blend(self, tmp_path):
+        import json
+
+        from realtime_fraud_detection_tpu.utils.config import Config
+
+        artifact = {
+            "selected_blend": {
+                "branches": ["isolation_forest", "lstm_sequential",
+                             "xgboost_primary"],
+                "weights": {"isolation_forest": 0.05,
+                            "lstm_sequential": 0.0625,
+                            "xgboost_primary": 0.4},
+            }
+        }
+        path = tmp_path / "q.json"
+        path.write_text(json.dumps(artifact))
+        cfg = Config()
+        applied = cfg.apply_quality_artifact(str(path))
+        assert applied == artifact["selected_blend"]["weights"]
+        enabled = cfg.get_enabled_models()
+        assert set(enabled) == {"isolation_forest", "lstm_sequential",
+                                "xgboost_primary"}
+        assert cfg.models["bert_text"].enabled is False
+        assert cfg.models["graph_neural"].enabled is False
+        # device combine sees the renormalized artifact weights
+        norm = cfg.normalized_weights()
+        assert norm["xgboost_primary"] == pytest.approx(0.4 / 0.5125)
+
+    def test_rejects_non_artifact_and_unknown_models(self, tmp_path):
+        import json
+
+        from realtime_fraud_detection_tpu.utils.config import Config
+
+        bad = tmp_path / "bad.json"
+        bad.write_text(json.dumps({"not": "an artifact"}))
+        with pytest.raises(ValueError, match="selected_blend"):
+            Config().apply_quality_artifact(str(bad))
+        unknown = tmp_path / "unknown.json"
+        unknown.write_text(json.dumps(
+            {"selected_blend": {"weights": {"mystery_model": 1.0}}}))
+        with pytest.raises(ValueError, match="mystery_model"):
+            Config().apply_quality_artifact(str(unknown))
+
+    def test_committed_artifact_applies_cleanly(self):
+        """The ACTUAL committed QUALITY_r05.json must deploy."""
+        from pathlib import Path
+
+        from realtime_fraud_detection_tpu.utils.config import Config
+
+        path = Path(__file__).resolve().parent.parent / "QUALITY_r05.json"
+        cfg = Config()
+        applied = cfg.apply_quality_artifact(str(path))
+        assert len(applied) >= 3          # the earned >=3-branch blend
+        assert set(cfg.get_enabled_models()) == set(applied)
