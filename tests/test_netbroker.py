@@ -358,3 +358,71 @@ class TestKillThePrimary:
             if proc.poll() is None:
                 proc.kill()
             replica.stop()
+
+
+class TestReplicationStress:
+    def test_concurrent_producers_with_sync_replication(self):
+        """4 producer threads + a committing consumer against a min_isr=2
+        pair: the replication lane (io_lock-serialized WAL + ship) must
+        neither deadlock nor diverge — replica ends with byte-identical
+        per-partition logs."""
+        import threading
+
+        replica = BrokerServer(port=0, role="replica").start()
+        primary = BrokerServer(port=0, min_isr=2).start()
+        primary.add_replica("127.0.0.1", replica.port)
+        n_threads, per_thread = 4, 150
+        errors: list = []
+
+        def produce(tid: int) -> None:
+            client = NetBrokerClient(port=primary.port)
+            try:
+                for i in range(per_thread):
+                    client.produce(T.TRANSACTIONS,
+                                   {"t": tid, "n": i}, key=f"u{(tid * 7 + i) % 23}")
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+            finally:
+                client.close()
+
+        consumer_client = NetBrokerClient(port=primary.port)
+        stop = threading.Event()
+
+        def consume() -> None:
+            c = consumer_client.consumer([T.TRANSACTIONS], "stress-g")
+            try:
+                while not stop.is_set():
+                    if c.poll(200):
+                        c.commit()
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=produce, args=(t,))
+                   for t in range(n_threads)]
+        ct = threading.Thread(target=consume)
+        ct.start()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        stop.set()
+        ct.join(timeout=30)
+        assert not errors, errors[:3]
+
+        pclient = NetBrokerClient(port=primary.port)
+        rclient = NetBrokerClient(port=replica.port)
+        try:
+            total = n_threads * per_thread
+            assert sum(pclient.end_offsets(T.TRANSACTIONS)) == total
+            assert sum(rclient.end_offsets(T.TRANSACTIONS)) == total
+            for p in range(pclient.partitions(T.TRANSACTIONS)):
+                prim = pclient.read(T.TRANSACTIONS, p, 0, total)
+                rep = rclient.read(T.TRANSACTIONS, p, 0, total)
+                assert [(r.offset, r.key, r.value) for r in prim] == \
+                       [(r.offset, r.key, r.value) for r in rep]
+        finally:
+            pclient.close()
+            rclient.close()
+            consumer_client.close()
+            primary.stop()
+            replica.stop()
