@@ -49,14 +49,10 @@ def cmd_simulate(args: argparse.Namespace) -> int:
         # role) through the ingress gateway: generation paces here, the
         # gateway's C++ lock-free queue + sender thread overlaps the
         # network produce with generation
-        from realtime_fraud_detection_tpu.stream import (
-            IngressGateway,
-            NetBrokerClient,
-        )
+        from realtime_fraud_detection_tpu.stream import IngressGateway
         from realtime_fraud_detection_tpu.stream import topics as T
 
-        host, port = _addr(args.broker, 9092)
-        client = NetBrokerClient(host=host, port=port)
+        client = _broker_client(args.broker)
         gateway = IngressGateway(client, T.TRANSACTIONS)
         n_fraud = produced = 0
         try:
@@ -100,6 +96,24 @@ def _addr(spec: str, default_port: int) -> tuple[str, int]:
     return host or "127.0.0.1", int(port or default_port)
 
 
+def _broker_client(spec: str, default_port: int = 9092):
+    """Broker client from an address spec. A comma-separated list (the
+    replicated-cluster deployment, primary first) returns an
+    HaBrokerClient that rotates on connection loss or a not-yet-promoted
+    replica's READONLY; a single address returns the plain client."""
+    from realtime_fraud_detection_tpu.stream import (
+        HaBrokerClient,
+        NetBrokerClient,
+    )
+
+    addrs = [_addr(a, default_port) for a in spec.split(",") if a.strip()]
+    if not addrs:
+        raise ValueError(f"no broker address in {spec!r}")
+    if len(addrs) > 1:
+        return HaBrokerClient(addrs)
+    return NetBrokerClient(host=addrs[0][0], port=addrs[0][1])
+
+
 def cmd_run_job(args: argparse.Namespace) -> int:
     """End-to-end streaming job: simulator -> broker -> microbatched TPU
     scorer -> output topics, with checkpointing + durable job metadata."""
@@ -121,10 +135,7 @@ def cmd_run_job(args: argparse.Namespace) -> int:
                                num_merchants=args.merchants,
                                seed=args.seed, tps=args.tps)
     if args.broker:
-        from realtime_fraud_detection_tpu.stream import NetBrokerClient
-
-        bhost, bport = _addr(args.broker, 9092)
-        broker = NetBrokerClient(host=bhost, port=bport)
+        broker = _broker_client(args.broker)
     else:
         broker = InMemoryBroker()
     state_client = None
@@ -575,20 +586,9 @@ def cmd_alert_router(args: argparse.Namespace) -> int:
     import time as _time
     import urllib.request
 
-    from realtime_fraud_detection_tpu.stream import (
-        HaBrokerClient,
-        NetBrokerClient,
-    )
     from realtime_fraud_detection_tpu.stream import topics as T
 
-    # comma-separated addresses = failover list (HaBrokerClient rotates on
-    # connection loss / a replica's READONLY); a single address keeps the
-    # plain client
-    addrs = [_addr(a, 9092) for a in args.broker.split(",") if a.strip()]
-    if len(addrs) > 1:
-        broker = HaBrokerClient(addrs)
-    else:
-        broker = NetBrokerClient(host=addrs[0][0], port=addrs[0][1])
+    broker = _broker_client(args.broker)
     consumer = broker.consumer([T.ALERTS], args.group)
     routed = 0
     backoff = 1.0
@@ -715,7 +715,7 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--count", type=int, default=1000)
     sp.add_argument("--output", default="-")
     sp.add_argument("--broker", default="",
-                    help="produce to a broker (host:port) at ~tps instead "
+                    help="produce to a broker (host:port, or comma list) at ~tps instead "
                          "of writing JSON lines")
     sp.set_defaults(fn=cmd_simulate)
 
@@ -727,7 +727,7 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--duration", type=float, default=0.0,
                     help="consume-only runtime seconds (0 = forever)")
     sp.add_argument("--broker", default="",
-                    help="external broker host:port (default: in-memory)")
+                    help="external broker host:port, or a comma list for the replicated cluster (default: in-memory)")
     sp.add_argument("--state", default="",
                     help="shared state server host:port (RESP)")
     sp.add_argument("--batch", type=int, default=256)
