@@ -231,23 +231,44 @@ def cmd_serve(args: argparse.Namespace) -> int:
         config.serving.host = args.host
     if args.port is not None:
         config.serving.port = args.port
+    scorer_kwargs: Dict[str, Any] = {}
     if getattr(args, "quality_artifact", ""):
         applied = config.apply_quality_artifact(args.quality_artifact)
         print(f"serving the measured blend from {args.quality_artifact}: "
               f"{applied}", file=sys.stderr)
+        # the artifact records the text-branch architecture + tokenizer the
+        # blend was measured (and its checkpoint trained) with — the scorer
+        # must be built to match or a checkpoint restore would mismatch
+        with open(args.quality_artifact) as f:
+            proto = json.load(f).get("protocol", {})
+        if proto.get("text_model"):
+            from realtime_fraud_detection_tpu.models.bert import BertConfig
+
+            scorer_kwargs["bert_config"] = BertConfig(**proto["text_model"])
     scorer = None
     state_addr = args.state or os.environ.get("RTFD_STATE_ADDR", "")
-    if state_addr:
+    if state_addr or scorer_kwargs:
         from realtime_fraud_detection_tpu.scoring import (
             FraudScorer,
             ScorerConfig,
         )
-        from realtime_fraud_detection_tpu.state import RespClient
 
-        shost, sport = _addr(state_addr, 6379)
-        scorer = FraudScorer(config, scorer_config=ScorerConfig(),
-                             state_client=RespClient(host=shost, port=sport))
-        print(f"using shared state tier at {state_addr}", file=sys.stderr)
+        sc = ScorerConfig()
+        if getattr(args, "quality_artifact", "") and proto.get("text_model"):
+            import dataclasses as _dc
+
+            sc = _dc.replace(
+                sc, text_len=int(proto.get("text_len", 32)),
+                tokenizer=proto.get("tokenizer", "word"))
+        if state_addr:
+            from realtime_fraud_detection_tpu.state import RespClient
+
+            shost, sport = _addr(state_addr, 6379)
+            scorer_kwargs["state_client"] = RespClient(host=shost,
+                                                       port=sport)
+            print(f"using shared state tier at {state_addr}",
+                  file=sys.stderr)
+        scorer = FraudScorer(config, scorer_config=sc, **scorer_kwargs)
     app = ServingApp(config=config, scorer=scorer)
     if args.checkpoint_dir:
         from realtime_fraud_detection_tpu.checkpoint import CheckpointManager
@@ -559,7 +580,8 @@ def cmd_quality_eval(args: argparse.Namespace) -> int:
         test_batches=args.test_batches)
     result = run_blend_eval(
         cfg, log=lambda m: print(f"[quality-eval] {m}", file=sys.stderr,
-                                 flush=True))
+                                 flush=True),
+        checkpoint_dir=args.checkpoint_dir or None)
     payload = json.dumps(result, indent=2)
     if args.output:
         with open(args.output, "w") as f:
@@ -827,6 +849,10 @@ def build_parser() -> argparse.ArgumentParser:
                     default=_BLEND_DEFAULTS.val_batches)
     sp.add_argument("--test-batches", type=int,
                     default=_BLEND_DEFAULTS.test_batches)
+    sp.add_argument("--checkpoint-dir", default="",
+                    help="also save the trained+calibrated branches as a "
+                         "serving checkpoint (deploy with serve "
+                         "--checkpoint-dir + --quality-artifact)")
     sp.set_defaults(fn=cmd_quality_eval)
 
     sp = sub.add_parser("alert-router",

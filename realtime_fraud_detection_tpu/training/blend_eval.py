@@ -154,9 +154,10 @@ def _train_branches(
     cfg: BlendEvalConfig, tr: Dict[str, np.ndarray],
     segments: Dict[str, Dict[str, np.ndarray]],
     log: Callable[[str], None],
-) -> Tuple[Dict[str, Dict[str, np.ndarray]], Dict[str, Dict[str, float]]]:
-    """Fit all five branches; return (scores[segment][branch],
-    platt calibration constants per neural branch)."""
+) -> Tuple[Dict[str, Dict[str, np.ndarray]], Dict[str, Dict[str, float]],
+           Dict[str, object]]:
+    """Fit all five branches; return (scores[segment][branch], platt
+    calibration constants per neural branch, trained+calibrated params)."""
     import jax
     import jax.numpy as jnp
     import optax
@@ -249,24 +250,39 @@ def _train_branches(
         d["unf"], d["unm"], d["mnf"], d["mnm"]))
         for k, d in segments.items()}
 
-    # Platt-calibrate the class-weighted branches on VALIDATION, (a, b)
-    # foldable into the head params (training/calibrate.py — the fold is
-    # exact, so these probabilities are what the calibrated model serves)
+    # Platt-calibrate the class-weighted branches on VALIDATION, and FOLD
+    # (a, b) into the head params (training/calibrate.py — the fold is
+    # exact, so these probabilities ARE what the calibrated model serves,
+    # and the returned params are the deployable calibrated branches)
     from realtime_fraud_detection_tpu.training.calibrate import (
+        calibrate_bert_head,
+        calibrate_gnn_head,
+        calibrate_lstm_head,
         platt_apply,
         platt_fit,
     )
 
     y_val = segments["val"]["y"]
     calibration = {}
-    for name, z in (("lstm_sequential", lstm_z), ("bert_text", text_z),
-                    ("graph_neural", gnn_z)):
+    folds = {"lstm_sequential": (lstm_z, lambda a, b: calibrate_lstm_head(lp, a, b)),
+             "bert_text": (text_z, lambda a, b: calibrate_bert_head(bp, a, b)),
+             "graph_neural": (gnn_z, lambda a, b: calibrate_gnn_head(gp, a, b))}
+    calibrated_params = {}
+    for name, (z, fold) in folds.items():
         a, b = platt_fit(z["val"], y_val)
         calibration[name] = {"a": round(a, 4), "b": round(b, 4)}
+        calibrated_params[name] = fold(a, b)
         for k in segments:
             scores[k][name] = platt_apply(z[k], a, b).astype(np.float32)
     log(f"platt calibration (fit on val): {calibration}")
-    return scores, calibration
+    trained = {
+        "trees": trees,
+        "iforest": ifo,
+        "lstm": calibrated_params["lstm_sequential"],
+        "bert": calibrated_params["bert_text"],
+        "gnn": calibrated_params["graph_neural"],
+    }
+    return scores, calibration, trained
 
 
 def _blend_fn(weights_by_name: Dict[str, float]):
@@ -305,8 +321,14 @@ def _blend_fn(weights_by_name: Dict[str, float]):
 
 
 def run_blend_eval(cfg: Optional[BlendEvalConfig] = None,
-                   log: Callable[[str], None] = lambda m: None) -> Dict:
-    """Execute the full protocol; returns the evidence dict (JSON-able)."""
+                   log: Callable[[str], None] = lambda m: None,
+                   checkpoint_dir: Optional[str] = None) -> Dict:
+    """Execute the full protocol; returns the evidence dict (JSON-able).
+
+    ``checkpoint_dir``: also save the trained + calibrated branches as a
+    serving checkpoint (orbax, step 0) with the text-arch recorded in its
+    metadata — the artifact + checkpoint pair is a complete deployment:
+    ``rtfd serve --checkpoint-dir D --quality-artifact Q.json``."""
     from realtime_fraud_detection_tpu.scoring import FraudScorer, ScorerConfig
     from realtime_fraud_detection_tpu.sim.simulator import (
         TransactionGenerator,
@@ -331,7 +353,7 @@ def run_blend_eval(cfg: Optional[BlendEvalConfig] = None,
     te = _collect(scorer, gen, cfg.test_batches, cfg.batch_size)
     segments = {"val": va, "test": te}
 
-    scores, calibration = _train_branches(cfg, tr, segments, log)
+    scores, calibration, trained = _train_branches(cfg, tr, segments, log)
     y_va, y_te = va["y"], te["y"]
 
     branch_auc = {
@@ -415,6 +437,26 @@ def run_blend_eval(cfg: Optional[BlendEvalConfig] = None,
         },
     }
 
+    checkpoint_info = None
+    if checkpoint_dir:
+        from realtime_fraud_detection_tpu.checkpoint import CheckpointManager
+        from realtime_fraud_detection_tpu.scoring import ScoringModels
+
+        models = ScoringModels(
+            trees=trained["trees"], iforest=trained["iforest"],
+            lstm=trained["lstm"], gnn=trained["gnn"], bert=trained["bert"])
+        CheckpointManager(checkpoint_dir).save(
+            0, params=models,
+            metadata={
+                "source": "blend_eval",
+                "text_model": dataclasses.asdict(cfg.bert),
+                "text_len": cfg.text_len,
+                "tokenizer": cfg.tokenizer,
+                "selected_blend": sorted(weights),
+            })
+        checkpoint_info = {"dir": str(checkpoint_dir), "step": 0}
+        log(f"saved trained+calibrated branches to {checkpoint_dir}")
+
     return {
         "protocol": {
             "stream": {"users": cfg.num_users,
@@ -428,8 +470,10 @@ def run_blend_eval(cfg: Optional[BlendEvalConfig] = None,
                           "(serving parity)",
             "tokenizer": cfg.tokenizer,
             "text_model": dataclasses.asdict(cfg.bert),
+            "text_len": cfg.text_len,
             "platt_calibration": calibration,
         },
+        "checkpoint": checkpoint_info,
         "branch_auc": branch_auc,
         "admission": admission,
         "selected_blend": {

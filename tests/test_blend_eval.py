@@ -218,3 +218,45 @@ class TestDeployMeasuredBlend:
         applied = cfg.apply_quality_artifact(str(path))
         assert len(applied) >= 3          # the earned >=3-branch blend
         assert set(cfg.get_enabled_models()) == set(applied)
+
+
+def test_protocol_checkpoint_deploys_into_matching_scorer(tmp_path):
+    """The full deployment loop: quality protocol -> trained+calibrated
+    checkpoint + artifact -> a scorer built to the artifact's arch restores
+    it and serves the measured blend."""
+    import json
+
+    from realtime_fraud_detection_tpu.checkpoint import CheckpointManager
+    from realtime_fraud_detection_tpu.models.bert import BertConfig
+    from realtime_fraud_detection_tpu.scoring import FraudScorer, ScorerConfig
+    from realtime_fraud_detection_tpu.sim.simulator import (
+        TransactionGenerator,
+    )
+    from realtime_fraud_detection_tpu.utils.config import Config
+
+    cfg = _tiny_cfg()
+    ckpt_dir = tmp_path / "blend-ckpt"
+    result = run_blend_eval(cfg, checkpoint_dir=str(ckpt_dir))
+    assert result["checkpoint"] == {"dir": str(ckpt_dir), "step": 0}
+    artifact = tmp_path / "quality.json"
+    artifact.write_text(json.dumps(result))
+
+    # serve side: blend from the artifact, scorer built to its recorded arch
+    serve_cfg = Config()
+    applied = serve_cfg.apply_quality_artifact(str(artifact))
+    proto = result["protocol"]
+    scorer = FraudScorer(
+        serve_cfg,
+        scorer_config=ScorerConfig(text_len=proto["text_len"],
+                                   tokenizer=proto["tokenizer"]),
+        bert_config=BertConfig(**proto["text_model"]))
+    ck = CheckpointManager(str(ckpt_dir)).restore_into_scorer(scorer)
+    assert ck.step == 0
+    gen = TransactionGenerator(num_users=30, num_merchants=12, seed=8)
+    scorer.seed_profiles(gen.users.profiles(), gen.merchants.profiles())
+    results = scorer.score_batch(gen.generate_batch(8))
+    assert len(results) == 8
+    for r in results:
+        # only the measured blend's branches contribute
+        assert set(r["model_predictions"]) == set(applied)
+        assert 0.0 <= r["fraud_probability"] <= 1.0
