@@ -177,6 +177,16 @@ def cmd_run_job(args: argparse.Namespace) -> int:
 
     t0 = time.perf_counter()
     produced = scored = step = 0
+    if ckpt is not None and ckpt.latest_step() is not None:
+        # resume: models + host state + transport offsets from the latest
+        # checkpoint (the Flink restore-from-checkpoint behavior); step
+        # numbering continues so retention never collides
+        ck = ckpt.restore_into_scorer(scorer)
+        if ck.offsets:
+            job.consumer.seek_to_positions(ck.offsets)
+        step = ck.step
+        print(f"resumed from checkpoint step {ck.step} "
+              f"({args.checkpoint_dir})", file=sys.stderr)
     try:
         if args.count == 0:
             # consume-only: an external simulator feeds the broker; run in

@@ -251,6 +251,18 @@ class Consumer:
         ("topic:partition" -> next offset) for checkpoint manifests."""
         return {f"{t}:{p}": pos for (t, p), pos in self._position.items()}
 
+    def seek_to_positions(self, offsets: Mapping[str, int]) -> None:
+        """Inverse of ``positions()``: restore read positions from a
+        checkpoint manifest. The offsets-as-truth resume path (reference:
+        Flink restores Kafka offsets from ITS checkpoint, not the broker,
+        JobConfig.java exactly-once contract): scorer state and transport
+        positions come from the SAME checkpoint, so effectively-once
+        scoring holds across a restart even against a broker whose group
+        offsets were lost."""
+        for key, off in offsets.items():
+            t, _, p = key.rpartition(":")
+            self._position[(t, int(p))] = int(off)
+
     def lag(self) -> int:
         return sum(self.broker.lag(self.group_id, t) for t in self.topics)
 

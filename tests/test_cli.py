@@ -235,3 +235,27 @@ class TestAlertRouter:
             client.close()
             broker.stop()
             hook.shutdown()
+
+
+class TestRunJobResume:
+    def test_second_run_resumes_from_checkpoint(self, tmp_path, capsys):
+        """run-job --checkpoint-dir restores models/host-state/offsets and
+        continues step numbering (the Flink restore-from-checkpoint
+        behavior) instead of starting over."""
+        ckpt_dir = str(tmp_path / "ck")
+        argv = ["run-job", "--count", "600", "--users", "50",
+                "--merchants", "20", "--batch", "64",
+                "--checkpoint-dir", ckpt_dir]
+        assert main(argv) == 0
+        from realtime_fraud_detection_tpu.checkpoint import CheckpointManager
+
+        first_steps = CheckpointManager(ckpt_dir).steps()
+        assert first_steps, "first run wrote no checkpoints"
+        capsys.readouterr()
+
+        assert main(argv) == 0
+        err = capsys.readouterr().err
+        assert f"resumed from checkpoint step {max(first_steps)}" in err
+        second_steps = CheckpointManager(ckpt_dir).steps()
+        # numbering continued past the first run's last step
+        assert max(second_steps) > max(first_steps)
