@@ -280,6 +280,18 @@ class FraudScorer:
 
         self._top_importances = top_feature_importances(importances)
 
+    def refresh_blend_from_config(self) -> None:
+        """Re-read ensemble weights/strategy and the enabled-branch set
+        from ``self.config`` — the zero-recompile blend swap (weights and
+        validity are runtime tensors to the fused program, not compile
+        constants). Callers hold the score lock; the next microbatch runs
+        the new blend."""
+        self.ensemble_params = EnsembleParams.from_config(
+            self.config, MODEL_NAMES)
+        enabled = self.config.get_enabled_models()
+        self.model_valid = np.asarray(
+            [n in enabled for n in MODEL_NAMES], bool)
+
     def set_models(self, models: ScoringModels) -> None:
         """Swap the model set (hot reload). Params are replicated onto this
         scorer's mesh — arrays restored from checkpoint arrive committed to

@@ -331,12 +331,31 @@ class ServingApp:
         """Hot swap under a lock (reference main.py:291-305 +
         model_manager.py:348-380). Body options:
         {"checkpoint_dir": ..., "step": optional} — restore params (and host
-        state if present) from a checkpoint; {} — fresh re-init (dummy-model
+        state if present) from a checkpoint; {"quality_artifact": path} —
+        re-blend live from a quality-eval artifact (weights + validity are
+        runtime tensors to the fused program, so a new measured blend
+        deploys with ZERO recompiles; combinable with checkpoint_dir to
+        swap params and blend together); {} — fresh re-init (dummy-model
         analog). The swap happens between batches: the scorer reads
         ``self.models`` once per score_batch call."""
         body = body or {}
         async with self._reload_lock:
             loop = asyncio.get_running_loop()
+            source: Dict[str, Any] = {}
+            if "quality_artifact" in body:
+                try:
+                    applied = self.config.apply_quality_artifact(
+                        str(body["quality_artifact"]))
+                except FileNotFoundError as e:
+                    raise HttpError(404, str(e))
+                except (ValueError, OSError) as e:
+                    raise HttpError(422, str(e))
+                with self._score_lock:
+                    self.scorer.refresh_blend_from_config()
+                source["quality_artifact"] = {
+                    "path": str(body["quality_artifact"]),
+                    "weights": applied,
+                }
             if "checkpoint_dir" in body:
                 step = body.get("step")
                 if step is not None:
@@ -359,8 +378,10 @@ class ServingApp:
                     raise HttpError(404, str(e))
                 except ValueError as e:
                     raise HttpError(409, str(e))   # config/shape mismatch
-                source = {"checkpoint": body["checkpoint_dir"],
-                          "step": ck.step}
+                source.update(checkpoint=body["checkpoint_dir"],
+                              step=ck.step)
+            elif "quality_artifact" in body:
+                pass                               # blend-only reload
             else:
                 import jax
 
@@ -375,7 +396,7 @@ class ServingApp:
                     with self._score_lock:
                         self.scorer.set_models(fresh)
                 await loop.run_in_executor(None, _reinit)
-                source = {"reinit_seed": seed}
+                source["reinit_seed"] = seed
             if self.prediction_cache is not None:
                 # cached responses describe the replaced models; clear()
                 # keeps the monotonic hit/miss counters /health exposes

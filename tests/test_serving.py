@@ -388,6 +388,44 @@ class TestEndpoints:
         status, _ = _request(app.port, "POST", "/predict", _txn(gen))
         assert status == 200
 
+
+    def test_reload_quality_artifact_reblends_live(self, app_server,
+                                                   tmp_path):
+        """POST /reload-models {"quality_artifact": ...}: a new measured
+        blend (enabled set + weights) deploys with zero recompiles — the
+        next prediction carries only the artifact's branches."""
+        import json as _json
+
+        app, gen = app_server
+        artifact = tmp_path / "q.json"
+        artifact.write_text(_json.dumps({
+            "selected_blend": {
+                "branches": ["xgboost_primary", "lstm_sequential"],
+                "weights": {"xgboost_primary": 0.4,
+                            "lstm_sequential": 0.1},
+            }
+        }))
+        status, data = _request(app.port, "POST", "/reload-models",
+                                {"quality_artifact": str(artifact)})
+        assert status == 200
+        assert data["source"]["quality_artifact"]["weights"] == {
+            "xgboost_primary": 0.4, "lstm_sequential": 0.1}
+        status, info = _request(app.port, "GET", "/model-info")
+        assert status == 200
+        enabled = {n for n, m in info["models"].items() if m["enabled"]}
+        assert enabled == {"xgboost_primary", "lstm_sequential"}
+        status, pred = _request(app.port, "POST", "/predict", _txn(gen))
+        assert status == 200
+        assert set(pred["model_predictions"]) == enabled
+
+    def test_reload_bad_quality_artifact_422(self, app_server, tmp_path):
+        app, _ = app_server
+        bad = tmp_path / "bad.json"
+        bad.write_text("{}")
+        status, _ = _request(app.port, "POST", "/reload-models",
+                             {"quality_artifact": str(bad)})
+        assert status == 422
+
     def test_reload_missing_checkpoint_404(self, app_server, tmp_path):
         app, _ = app_server
         status, _ = _request(app.port, "POST", "/reload-models",
