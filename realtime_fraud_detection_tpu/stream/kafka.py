@@ -620,6 +620,22 @@ class KafkaBroker:
             self._produce_raw(topic, part, msgs)
         return n
 
+    def produce_batch_keyed(self, topic: str, items) -> int:
+        """(key, value) pairs batched into per-partition RecordBatches —
+        same wire efficiency as produce_batch, explicit keys."""
+        by_part: Dict[int, list] = {}
+        now_ms = int(time.time() * 1000)
+        n = 0
+        for key, v in items:
+            part = self._pick_partition(topic, key)
+            by_part.setdefault(part, []).append((
+                key.encode() if key is not None else None,
+                json.dumps(v, separators=(",", ":")).encode(), now_ms))
+            n += 1
+        for part, msgs in by_part.items():
+            self._produce_raw(topic, part, msgs)
+        return n
+
     def _init_producer_id(self) -> None:
         """InitProducerId v0: acquire (producer_id, epoch) for idempotence."""
         body = Writer().string(None).i32(60_000).done()

@@ -607,6 +607,15 @@ class NetBrokerClient:
         return self._call({"op": "produce_batch", "topic": topic,
                            "records": items})["n"]
 
+    def produce_batch_keyed(self, topic: str, items) -> int:
+        """(key, value) pairs in ONE frame — the fan-out hot path
+        (one TCP round trip instead of one per record)."""
+        records = [{"v": v, "k": k} for k, v in items]
+        if not records:
+            return 0
+        return self._call({"op": "produce_batch", "topic": topic,
+                           "records": records})["n"]
+
     # ------------------------------------------------------------- consume
     def consumer(self, topics: Sequence[str], group_id: str,
                  faults: Optional[FaultInjector] = None) -> Consumer:
