@@ -260,6 +260,7 @@ class StreamJob:
         the predictions topic so downstream sees a REVIEW decision, never a
         silent gap. Covered by this batch's offset commit."""
         results = []
+        items = []
         for rec, errors in ctx.invalid:
             value = rec.value if isinstance(rec.value, dict) else {}
             res = {
@@ -274,9 +275,11 @@ class StreamJob:
                 "explanation": {"error": True, "validation_errors": errors},
             }
             self.counters["errors"] += 1
-            self.broker.produce(self.config.predictions_topic, res,
-                                key=str(value.get("user_id", "")))
+            items.append((str(value.get("user_id", "")), res))
             results.append(res)
+        if items:
+            self.broker.produce_batch_keyed(self.config.predictions_topic,
+                                            items)
         return results
 
     def _emit_cached_dups(self, ctx: "_BatchCtx") -> None:
@@ -285,10 +288,11 @@ class StreamJob:
         previously; whether its prediction was actually produced before a
         crash is unknowable, so re-emitting is the at-least-once answer —
         downstream consumers dedupe by transaction_id."""
+        items = []
         for rec, cached in ctx.cached_dups:
             value = rec.value if isinstance(rec.value, dict) else {}
-            self.broker.produce(
-                self.config.predictions_topic,
+            items.append((
+                str(value.get("user_id", "")),
                 {
                     "transaction_id": str(cached.get("transaction_id") or
                                           value.get("transaction_id", "")),
@@ -301,8 +305,10 @@ class StreamJob:
                     "processing_time_ms": 0.0,
                     "explanation": {"replayed_from_cache": True},
                 },
-                key=str(value.get("user_id", "")),
-            )
+            ))
+        if items:
+            self.broker.produce_batch_keyed(self.config.predictions_topic,
+                                            items)
 
     def _fan_out(self, ctx: "_BatchCtx", fresh: List[Record],
                  results: List[Dict[str, Any]], feats, scored_ok: bool,
