@@ -419,11 +419,21 @@ class ServingApp:
         body = body or {}
         try:
             name = body["name"]
-            variants = [Variant(v["name"], float(v["traffic"]),
-                                v.get("overrides", {}))
-                        for v in body["variants"]]
-            self.ab.create_experiment(name, variants,
-                                      salt=body.get("salt", ""))
+            if "from_quality_artifact" in body:
+                # canary a measured blend: control = production weights,
+                # treatment = the artifact's selected blend at `traffic`
+                self.ab.experiment_from_artifact(
+                    name, str(body["from_quality_artifact"]),
+                    traffic=float(body.get("traffic", 0.5)),
+                    salt=body.get("salt", ""))
+            else:
+                variants = [Variant(v["name"], float(v["traffic"]),
+                                    v.get("overrides", {}))
+                            for v in body["variants"]]
+                self.ab.create_experiment(name, variants,
+                                          salt=body.get("salt", ""))
+        except FileNotFoundError as e:
+            raise HttpError(404, str(e))
         except (KeyError, TypeError) as e:
             raise HttpError(422, f"bad experiment spec: {e}")
         except ValueError as e:

@@ -182,6 +182,35 @@ class ABTestManager:
             self._stats[name] = {v.name: VariantStats() for v in variants}
         return exp
 
+    def experiment_from_artifact(self, name: str, artifact_path: str,
+                                 traffic: float = 0.5,
+                                 salt: str = "") -> Experiment:
+        """Canary a measured blend: control = current production weights
+        (no overrides), treatment = a quality-eval artifact's
+        selected_blend at ``traffic`` share. The treatment rides variant
+        weight overrides, so serving re-weights host-side over the
+        already-returned per-branch predictions (apply_weight_overrides) —
+        zero extra device work per arm. Branches outside the artifact's
+        blend are overridden to weight 0, matching the artifact's
+        semantics exactly."""
+        import json
+
+        from realtime_fraud_detection_tpu.scoring import MODEL_NAMES
+
+        with open(artifact_path) as f:
+            weights = json.load(f).get("selected_blend", {}).get(
+                "weights", {})
+        if not weights:
+            raise ValueError(
+                f"{artifact_path} has no selected_blend.weights — not a "
+                f"quality-eval artifact?")
+        overrides = {"weights": {n: float(weights.get(n, 0.0))
+                                 for n in MODEL_NAMES}}
+        return self.create_experiment(name, [
+            Variant("control", 1.0 - traffic),
+            Variant("artifact", traffic, overrides=overrides),
+        ], salt=salt)
+
     def stop_experiment(self, name: str) -> None:
         with self._lock:
             self._experiments[name].active = False

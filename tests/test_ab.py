@@ -142,3 +142,41 @@ class TestEvaluation:
         two_arm(mgr, split=0.0)               # everyone → treatment
         ov = mgr.route_config_overrides("exp", "anyone")
         assert ov == {"weights": {"bert_text": 0.3}}
+
+
+class TestExperimentFromArtifact:
+    def test_canary_blend_variants(self, tmp_path):
+        """experiment_from_artifact: treatment carries the artifact's
+        selected weights with excluded branches zeroed (matching the
+        artifact's semantics), control carries no overrides."""
+        import json
+
+        from realtime_fraud_detection_tpu.scoring import MODEL_NAMES
+        from realtime_fraud_detection_tpu.testing.ab import ABTestManager
+
+        artifact = tmp_path / "q.json"
+        artifact.write_text(json.dumps({"selected_blend": {"weights": {
+            "xgboost_primary": 0.4, "lstm_sequential": 0.1}}}))
+        ab = ABTestManager()
+        exp = ab.experiment_from_artifact("canary", str(artifact),
+                                          traffic=0.25)
+        names = {v.name: v for v in exp.variants}
+        assert names["control"].traffic == 0.75
+        assert not names["control"].overrides
+        w = names["artifact"].overrides["weights"]
+        assert set(w) == set(MODEL_NAMES)
+        assert w["xgboost_primary"] == 0.4 and w["lstm_sequential"] == 0.1
+        assert w["bert_text"] == 0.0 and w["graph_neural"] == 0.0
+        # sticky routing still works over the two arms
+        got = {ab.assign("canary", f"user{i}").name for i in range(200)}
+        assert got == {"control", "artifact"}
+
+    def test_rejects_non_artifact(self, tmp_path):
+        import pytest as _pytest
+
+        from realtime_fraud_detection_tpu.testing.ab import ABTestManager
+
+        bad = tmp_path / "bad.json"
+        bad.write_text("{}")
+        with _pytest.raises(ValueError, match="selected_blend"):
+            ABTestManager().experiment_from_artifact("x", str(bad))
