@@ -421,9 +421,32 @@ class ServingApp:
             name = body["name"]
             if "from_quality_artifact" in body:
                 # canary a measured blend: control = production weights,
-                # treatment = the artifact's selected blend at `traffic`
+                # treatment = the artifact's selected blend at `traffic`.
+                # Every artifact branch must be ENABLED in the live scorer:
+                # host-side re-weighting can only use predictions the fused
+                # program returned (a disabled branch's weight would be
+                # silently renormalized away — a control-vs-wrong-thing
+                # experiment). Enable first via /reload-models.
+                from realtime_fraud_detection_tpu.scoring import MODEL_NAMES
+                from realtime_fraud_detection_tpu.utils.config import (
+                    Config,
+                )
+
+                art = str(body["from_quality_artifact"])
+                weights = Config.load_selected_blend_weights(art)
+                disabled = [
+                    n for n in weights
+                    if n in MODEL_NAMES
+                    and not self.scorer.model_valid[MODEL_NAMES.index(n)]
+                ]
+                if disabled:
+                    raise HttpError(
+                        409, f"artifact blend uses branch(es) {disabled} "
+                             f"that are disabled in the current "
+                             f"deployment; enable them first (POST "
+                             f"/reload-models with the artifact)")
                 self.ab.experiment_from_artifact(
-                    name, str(body["from_quality_artifact"]),
+                    name, art,
                     traffic=float(body.get("traffic", 0.5)),
                     salt=body.get("salt", ""))
             else:

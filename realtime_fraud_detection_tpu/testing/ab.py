@@ -192,19 +192,21 @@ class ABTestManager:
         already-returned per-branch predictions (apply_weight_overrides) —
         zero extra device work per arm. Branches outside the artifact's
         blend are overridden to weight 0, matching the artifact's
-        semantics exactly."""
-        import json
-
+        semantics exactly. NOTE: serving can only re-weight branches that
+        actually computed a prediction — canarying a blend that
+        re-includes a branch disabled in the current deployment requires
+        enabling it first (/reload-models with the artifact); the serving
+        endpoint enforces this."""
         from realtime_fraud_detection_tpu.scoring import MODEL_NAMES
+        from realtime_fraud_detection_tpu.utils.config import Config
 
-        with open(artifact_path) as f:
-            weights = json.load(f).get("selected_blend", {}).get(
-                "weights", {})
-        if not weights:
+        weights = Config.load_selected_blend_weights(artifact_path)
+        unknown = [n for n in weights if n not in MODEL_NAMES]
+        if unknown:
             raise ValueError(
-                f"{artifact_path} has no selected_blend.weights — not a "
-                f"quality-eval artifact?")
-        overrides = {"weights": {n: float(weights.get(n, 0.0))
+                f"artifact names unknown model(s) {unknown}; "
+                f"known: {list(MODEL_NAMES)}")
+        overrides = {"weights": {n: weights.get(n, 0.0)
                                  for n in MODEL_NAMES}}
         return self.create_experiment(name, [
             Variant("control", 1.0 - traffic),

@@ -302,6 +302,23 @@ class Config:
         if model_name in self.models:
             self.models[model_name].enabled = True
 
+    @staticmethod
+    def load_selected_blend_weights(artifact_path: str) -> Dict[str, float]:
+        """Parse a quality-eval artifact's ``selected_blend.weights`` —
+        the ONE place the artifact schema is read (apply_quality_artifact
+        and the A/B canary both call it). Malformed shapes raise
+        ValueError, never AttributeError."""
+        with open(artifact_path) as f:
+            artifact = json.load(f)
+        blend = (artifact.get("selected_blend")
+                 if isinstance(artifact, dict) else None)
+        weights = blend.get("weights") if isinstance(blend, dict) else None
+        if not isinstance(weights, dict) or not weights:
+            raise ValueError(
+                f"{artifact_path} has no selected_blend.weights — not a "
+                f"quality-eval artifact?")
+        return {str(n): float(w) for n, w in weights.items()}
+
     def apply_quality_artifact(self, artifact_path: str) -> Dict[str, float]:
         """Deploy a measured blend: set enabled models + weights from a
         quality-eval artifact (`rtfd quality-eval` / QUALITY_r*.json).
@@ -314,13 +331,7 @@ class Config:
         the blend stay configured but disabled (hot-enable later via
         /reload-models + enable_model without a recompile). Returns the
         applied weights."""
-        with open(artifact_path) as f:
-            artifact = json.load(f)
-        weights = artifact.get("selected_blend", {}).get("weights", {})
-        if not weights:
-            raise ValueError(
-                f"{artifact_path} has no selected_blend.weights — not a "
-                f"quality-eval artifact?")
+        weights = self.load_selected_blend_weights(artifact_path)
         unknown = [n for n in weights if n not in self.models]
         if unknown:
             raise ValueError(

@@ -180,3 +180,23 @@ class TestExperimentFromArtifact:
         bad.write_text("{}")
         with _pytest.raises(ValueError, match="selected_blend"):
             ABTestManager().experiment_from_artifact("x", str(bad))
+
+    def test_rejects_unknown_model_and_non_dict_shapes(self, tmp_path):
+        import json
+
+        import pytest as _pytest
+
+        from realtime_fraud_detection_tpu.testing.ab import ABTestManager
+
+        unknown = tmp_path / "unknown.json"
+        unknown.write_text(json.dumps(
+            {"selected_blend": {"weights": {"mystery": 1.0}}}))
+        with _pytest.raises(ValueError, match="mystery"):
+            ABTestManager().experiment_from_artifact("x", str(unknown))
+        # non-dict shapes must raise ValueError, never AttributeError
+        for payload in ("[]", '{"selected_blend": []}',
+                        '{"selected_blend": {"weights": []}}'):
+            bad = tmp_path / "shape.json"
+            bad.write_text(payload)
+            with _pytest.raises(ValueError, match="selected_blend"):
+                ABTestManager().experiment_from_artifact("y", str(bad))

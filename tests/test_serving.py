@@ -432,6 +432,38 @@ class TestEndpoints:
                              {"checkpoint_dir": str(tmp_path / "nope")})
         assert status == 404
 
+
+    def test_canary_artifact_requires_enabled_branches(self, app_server,
+                                                       tmp_path):
+        """POST /experiments from_quality_artifact: a blend using a branch
+        disabled in the live deployment is refused with 409 (host-side
+        re-weighting cannot resurrect a prediction that was never
+        computed), and accepted once the branch set is enabled."""
+        import json as _json
+
+        from realtime_fraud_detection_tpu.scoring import MODEL_NAMES
+
+        app, _ = app_server
+        artifact = tmp_path / "q.json"
+        artifact.write_text(_json.dumps({"selected_blend": {"weights": {
+            "xgboost_primary": 0.4, "bert_text": 0.15}}}))
+        idx = list(MODEL_NAMES).index("bert_text")
+        was = bool(app.scorer.model_valid[idx])
+        app.scorer.model_valid[idx] = False
+        try:
+            status, data = _request(app.port, "POST", "/experiments",
+                                    {"name": "canary-disabled",
+                                     "from_quality_artifact": str(artifact)})
+            assert status == 409
+            app.scorer.model_valid[idx] = True
+            status, data = _request(app.port, "POST", "/experiments",
+                                    {"name": "canary-enabled",
+                                     "from_quality_artifact": str(artifact),
+                                     "traffic": 0.3})
+            assert status == 200 and data["experiment"] == "canary-enabled"
+        finally:
+            app.scorer.model_valid[idx] = was
+
     def test_drift_endpoint(self, app_server):
         app, _ = app_server
         status, data = _request(app.port, "GET", "/drift")
