@@ -1,6 +1,9 @@
 """Benchmark: the 5 BASELINE.json configs + latency decomposition, one chip.
 
-Prints ONE JSON line and ALWAYS exits 0 — even when the TPU relay is wedged.
+Prints the full result JSON line, then a compact (<2 KB) machine-parseable
+summary as the FINAL stdout line, and ALWAYS exits 0 — even when the TPU
+relay is wedged. (The driver tail-parses the last line; the full result's
+tens of KB used to get truncated mid-JSON — two rounds of ``parsed: null``.)
 
 Budget contract (VERDICT r4 item 1): the WHOLE script fits in
 ``RTFD_BENCH_BUDGET_S`` (default 840 s ≈ 14 min) wall-clock, and a valid
@@ -119,14 +122,87 @@ _CHILD = None          # active inner-bench Popen, killed by the emergency path
 _EMITTED = False
 
 
+def _compact_summary(result: dict) -> dict:
+    """The driver-facing digest of a full bench result.
+
+    Two rounds of BENCH_r*.json carried ``parsed: null`` because the driver
+    captures only the stdout TAIL and the full result line (bucket sweeps,
+    per-config latency tables, probe timelines) ran tens of KB — the line
+    got truncated mid-JSON and nothing parsed. The FINAL stdout line is now
+    this compact (<2 KB) summary; the full result is printed on the
+    preceding stdout line and duplicated to stderr-adjacent logs.
+    """
+    cfgs = {
+        name: cfg.get("txn_per_s")
+        for name, cfg in (result.get("configs") or {}).items()
+        if isinstance(cfg, dict)
+    }
+    sweep = result.get("bucket_sweep") or {}
+    op = sweep.get("operating_point") or None
+    e2e = result.get("e2e_stream") or {}
+    quality = result.get("quality") or {}
+    mfu = (result.get("mfu") or {}).get("mfu")
+    compact = {
+        "metric": result.get("metric", METRIC_NAME),
+        "value": result.get("value", 0.0),
+        "unit": result.get("unit", "txn/s/chip"),
+        "vs_baseline": result.get("vs_baseline", 0.0),
+        "device": result.get("device", "none"),
+        "partial": bool(result.get("partial", False)),
+        "wall_s": result.get("wall_s"),
+        "configs_txn_per_s": cfgs,
+        "sweep_passing": sweep.get("passing"),
+        "operating_point": ({"batch": op.get("batch"),
+                             "txn_per_s": op.get("txn_per_s"),
+                             "p99_net_of_rtt_ms": op.get(
+                                 "p99_net_of_rtt_ms")}
+                            if isinstance(op, dict) else None),
+        "e2e_stream_txn_per_s": e2e.get("txn_per_s"),
+        "quality": ({"auc": quality.get("auc"),
+                     "accuracy": quality.get("accuracy")}
+                    if quality else None),
+        "mfu": mfu,
+        "summary_of": "full result JSON on the preceding stdout line",
+    }
+    if result.get("latest_committed_tpu_capture"):
+        cap = result["latest_committed_tpu_capture"]
+        headline = cap.get("headline")
+        if isinstance(headline, dict):
+            headline = headline.get("value", headline.get("txn_per_s"))
+        compact["latest_committed_tpu_capture"] = {
+            "round": cap.get("round"),
+            "file": cap.get("file"),
+            "headline_txn_per_s": headline,
+        }
+    if result.get("error"):
+        compact["error"] = str(result["error"])[:300]
+    # hard cap: the contract is < 2 KB, machine-parseable, on ONE line
+    line = json.dumps(compact, separators=(",", ":"))
+    while len(line.encode()) >= 2048:
+        for victim in ("configs_txn_per_s", "operating_point", "quality",
+                       "latest_committed_tpu_capture", "error"):
+            if compact.pop(victim, None) is not None:
+                break
+        else:
+            compact = {"metric": compact.get("metric"),
+                       "value": compact.get("value"),
+                       "device": compact.get("device")}
+        line = json.dumps(compact, separators=(",", ":"))
+    return compact
+
+
 def _emit_and_exit() -> None:
-    """Print the best-known JSON line exactly once and exit 0."""
+    """Print the full result, then the compact summary as the FINAL stdout
+    line (the driver parses the last line; see _compact_summary), exactly
+    once, and exit 0."""
     global _EMITTED
     if _EMITTED:
         os._exit(0)
     _EMITTED = True
     try:
         print(json.dumps(_BEST), flush=True)
+        print(json.dumps(_compact_summary(_BEST), separators=(",", ":")),
+              flush=True)
     finally:
         os._exit(0)
 
@@ -267,7 +343,14 @@ def _cpu_env() -> dict:
 
 def _attach_tpu_capture(result: dict) -> None:
     """When the relay is down at bench time, surface the newest committed
-    on-chip capture so a wedged relay can't erase measured TPU performance."""
+    on-chip capture so a wedged relay can't erase measured TPU performance.
+
+    Named ``latest_committed_tpu_capture`` (it is the newest COMMITTED
+    capture, possibly from an earlier round — the old ``same_round_``
+    name overclaimed) with an explicit ``round`` parsed from the filename.
+    """
+    import re
+
     here = os.path.dirname(os.path.abspath(__file__))
     captures = sorted(glob.glob(os.path.join(here, "BENCH_r*_tpu_capture.json")))
     if not captures:
@@ -275,12 +358,16 @@ def _attach_tpu_capture(result: dict) -> None:
     try:
         with open(captures[-1]) as f:
             cap = json.load(f)
-        result["same_round_tpu_capture"] = {
+        fname = os.path.basename(captures[-1])
+        m = re.match(r"BENCH_r(\d+)_tpu_capture", fname)
+        result["latest_committed_tpu_capture"] = {
             "headline": cap.get("headline"),
-            "file": os.path.basename(captures[-1]),
-            "note": "committed during a live relay window; see capture_note "
-                    "inside the file for methodology, and MEASUREMENTS_r*"
-                    ".json for the instrumented soak/sweep data",
+            "file": fname,
+            "round": int(m.group(1)) if m else None,
+            "note": "newest committed capture from a live relay window "
+                    "(NOT necessarily this round); see capture_note inside "
+                    "the file for methodology, and MEASUREMENTS_r*.json "
+                    "for the instrumented soak/sweep data",
         }
     except (OSError, ValueError):
         pass

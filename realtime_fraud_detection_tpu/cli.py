@@ -147,10 +147,17 @@ def cmd_run_job(args: argparse.Namespace) -> int:
     scorer = FraudScorer(scorer_config=ScorerConfig(),
                          state_client=state_client)
     scorer.seed_profiles(gen.users.profiles(), gen.merchants.profiles())
+    qos_settings = None
+    if getattr(args, "qos", False):
+        from realtime_fraud_detection_tpu.utils.config import QosSettings
+
+        qos_settings = QosSettings(
+            enabled=True, budget_ms=args.qos_budget_ms,
+            admission_rate=args.qos_rate)
     job = StreamJob(broker, scorer, JobConfig(
         max_batch=args.batch, enable_analytics=args.analytics,
         enable_enrichment=args.enrichment,
-        pipeline_depth=args.pipeline_depth))
+        pipeline_depth=args.pipeline_depth, qos=qos_settings))
 
     metadata: Optional[MetadataStore] = None
     ckpt: Optional[CheckpointManager] = None
@@ -241,6 +248,12 @@ def cmd_serve(args: argparse.Namespace) -> int:
         config.serving.host = args.host
     if args.port is not None:
         config.serving.port = args.port
+    if getattr(args, "qos", False):
+        config.qos.enabled = True
+    if getattr(args, "qos_budget_ms", None):
+        config.qos.budget_ms = args.qos_budget_ms
+    if getattr(args, "qos_rate", None):
+        config.qos.admission_rate = args.qos_rate
     scorer_kwargs: Dict[str, Any] = {}
     if getattr(args, "quality_artifact", ""):
         applied = config.apply_quality_artifact(args.quality_artifact)
@@ -693,6 +706,27 @@ def cmd_alert_router(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_qos_drill(args: argparse.Namespace) -> int:
+    """Deterministic overload demo for the QoS plane (qos/drill.py): drive
+    offered load at N× the sustainable rate through the real stream path on
+    a virtual clock; print the admission/ladder/budget outcome as JSON.
+    Exit 1 if the admitted p99 missed the configured budget."""
+    from realtime_fraud_detection_tpu.qos import run_overload_drill
+
+    summary = run_overload_drill(
+        offered_multiplier=args.multiplier,
+        overload_s=args.overload_s,
+        recovery_s=args.recovery_s,
+        max_batch=args.batch,
+        budget_ms=args.budget_ms,
+        high_frac=args.high_frac,
+        low_frac=args.low_frac,
+        seed=args.seed,
+    )
+    print(json.dumps(summary, indent=2))
+    return 0 if summary["p99_within_budget"] else 1
+
+
 def cmd_health_check(args: argparse.Namespace) -> int:
     """Probe a running scoring service (health-check.sh analog)."""
     import urllib.error
@@ -777,6 +811,13 @@ def build_parser() -> argparse.ArgumentParser:
                     help="save params+state checkpoints per chunk")
     sp.add_argument("--metadata-db", default="",
                     help="SQLite path for durable job/checkpoint metadata")
+    sp.add_argument("--qos", action="store_true",
+                    help="enable the deadline-aware QoS plane (admission + "
+                         "degradation ladder + latency budgets)")
+    sp.add_argument("--qos-budget-ms", type=float, default=20.0,
+                    help="per-transaction latency budget")
+    sp.add_argument("--qos-rate", type=float, default=0.0,
+                    help="admission token rate in txn/s (0 = unlimited)")
     sp.set_defaults(fn=cmd_run_job)
 
     sp = sub.add_parser("serve", help="run the scoring HTTP service")
@@ -792,6 +833,13 @@ def build_parser() -> argparse.ArgumentParser:
                     help="deploy the measured blend from a quality-eval "
                          "JSON (e.g. QUALITY_r05.json): enabled branches "
                          "+ weights become the artifact's selected_blend")
+    sp.add_argument("--qos", action="store_true",
+                    help="enable the deadline-aware QoS plane (also "
+                         "toggleable at runtime via POST /qos)")
+    sp.add_argument("--qos-budget-ms", type=float, default=0.0,
+                    help="per-transaction latency budget (0 = default)")
+    sp.add_argument("--qos-rate", type=float, default=0.0,
+                    help="admission token rate in txn/s (0 = unlimited)")
     sp.set_defaults(fn=cmd_serve)
 
     sp = sub.add_parser("train", help="train tree models on synthetic data")
@@ -894,6 +942,27 @@ def build_parser() -> argparse.ArgumentParser:
                     help="host:port of the primary to replicate from "
                          "(read-only replica; promote by restarting without)")
     sp.set_defaults(fn=cmd_state_server)
+
+    sp = sub.add_parser("qos-drill",
+                        help="deterministic QoS overload demo "
+                             "(virtual clock, real stream path)")
+    sp.add_argument("--multiplier", type=float, default=2.0,
+                    help="offered load as a multiple of the sustainable "
+                         "rate")
+    sp.add_argument("--overload-s", type=float, default=1.5,
+                    help="virtual seconds of overload")
+    sp.add_argument("--recovery-s", type=float, default=1.5,
+                    help="virtual seconds of post-overload trickle")
+    sp.add_argument("--batch", type=int, default=64)
+    sp.add_argument("--budget-ms", type=float, default=20.0)
+    sp.add_argument("--high-frac", type=float, default=0.2,
+                    help="fraction of traffic in the high (never-shed) "
+                         "class")
+    sp.add_argument("--low-frac", type=float, default=0.5,
+                    help="fraction of traffic in the low (sheds-first) "
+                         "class")
+    sp.add_argument("--seed", type=int, default=7)
+    sp.set_defaults(fn=cmd_qos_drill)
 
     sp = sub.add_parser("bench", help="run the TPU benchmark")
     sp.set_defaults(fn=cmd_bench)

@@ -28,6 +28,12 @@ RISK_LEVEL_NAMES: tuple[str, ...] = (
 )
 VERY_LOW, LOW, MEDIUM, HIGH, CRITICAL = range(5)
 
+# The ONE copy of the ensemble risk-band rungs (ensemble_predictor.py:
+# 358-369) — shared by the device ladder (risk_level_code), its host
+# scalar twin (risk_level_name), and the host vectorized twin
+# (risk_level_codes_np, the QoS rules-only degraded path).
+RISK_LEVEL_THRESHOLDS: tuple[float, ...] = (0.3, 0.6, 0.8, 0.95)
+
 
 # One shared rung-default definition (utils/config.py) re-exported for the
 # device ladder (ensemble/combine.py) and this host-side twin.
@@ -62,8 +68,21 @@ def ensemble_decision_name(prob: float, confidence: float,
 def risk_level_name(prob: float) -> str:
     """Host-side scalar twin of ``risk_level_code``
     (ensemble_predictor.py:358-369)."""
-    code = (prob >= 0.3) + (prob >= 0.6) + (prob >= 0.8) + (prob >= 0.95)
+    code = sum(prob >= t for t in RISK_LEVEL_THRESHOLDS)
     return RISK_LEVEL_NAMES[int(code)]
+
+
+def risk_level_codes_np(probs) -> "np.ndarray":
+    """Host VECTORIZED twin of ``risk_level_code`` over a numpy array —
+    same rungs, same int codes; used where the device combine did not run
+    (the QoS rules-only degraded path)."""
+    import numpy as np
+
+    probs = np.asarray(probs)
+    code = np.zeros(probs.shape, np.int32)
+    for t in RISK_LEVEL_THRESHOLDS:
+        code += (probs >= t).astype(np.int32)
+    return code
 
 
 def model_confidence_value(prob: float, multiplier: float) -> float:
@@ -149,11 +168,12 @@ def make_decision(
 
 def risk_level_code(fraud_probability: jax.Array) -> jax.Array:
     """Five-level ensemble risk ladder (ensemble_predictor.py:358-369)."""
+    t0, t1, t2, t3 = RISK_LEVEL_THRESHOLDS
     return (
-        (fraud_probability >= 0.3).astype(jnp.int32)
-        + (fraud_probability >= 0.6)
-        + (fraud_probability >= 0.8)
-        + (fraud_probability >= 0.95)
+        (fraud_probability >= t0).astype(jnp.int32)
+        + (fraud_probability >= t1)
+        + (fraud_probability >= t2)
+        + (fraud_probability >= t3)
     ).astype(jnp.int32)
 
 

@@ -285,6 +285,32 @@ class MetricsCollector:
             "ml_throughput_tps", "Scored txns/sec over the last 60 s")
         self.queue_depth = r.gauge(
             "serving_queue_depth", "Requests waiting in the microbatcher")
+        # QoS plane (qos/): admission, shedding, degradation ladder, and
+        # per-transaction budget headroom — all on the same registry, so
+        # the existing /metrics/prometheus exposition carries them
+        self.qos_admitted = r.counter(
+            "qos_admitted_total", "Transactions admitted by the QoS plane",
+            ("priority",))
+        self.qos_shed = r.counter(
+            "qos_shed_total",
+            "Transactions shed by admission control (explicit decisions, "
+            "never silent drops)", ("priority", "reason"))
+        self.qos_ladder_level = r.gauge(
+            "qos_ladder_level",
+            "Current degradation-ladder level (0=full ensemble, "
+            "3=rules only)")
+        self.qos_ladder_transitions = r.counter(
+            "qos_ladder_transitions_total",
+            "Degradation-ladder steps", ("direction",))
+        self.qos_degraded_scored = r.counter(
+            "qos_degraded_scored_total",
+            "Transactions scored at a degraded ladder level", ("level",))
+        self.qos_budget_remaining = r.histogram(
+            "qos_budget_remaining_seconds",
+            "Per-transaction latency budget remaining at completion "
+            "(negative = deadline blown)",
+            buckets=(-0.1, -0.02, -0.005, 0.0, 0.001, 0.0025, 0.005,
+                     0.01, 0.015, 0.02, 0.05, 0.1))
 
     # ------------------------------------------------------------- recording
     def record_prediction(self, decision: str, fraud_score: float,

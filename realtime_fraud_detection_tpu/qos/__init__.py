@@ -1,0 +1,60 @@
+"""Deadline-aware quality-of-service plane.
+
+The north star (BASELINE.json) is p99 < 20 ms at 50k txn/s — but a latency
+target is only a *property of the system* if it still holds when the offered
+load exceeds what the accelerator can sustain. Production serving systems
+hold tail latency by shaping load BEFORE the device ("Scaling TensorFlow to
+300M predictions/sec", arXiv:2109.09541; deadline-aware batch assembly,
+arXiv:1904.07421). This package is that shaping layer:
+
+- ``admission``  — token-bucket admission control with priority classes
+  (high-value transactions never shed; shed decisions are explicit
+  scores-with-reason, never silent drops).
+- ``budget``     — per-transaction latency budgets (ingest timestamp →
+  remaining deadline); the microbatchers consult it so a batch closes
+  early when the oldest waiter's budget runs low.
+- ``ladder``     — the degradation ladder with hysteresis: under sustained
+  backlog the ensemble steps down (full 5-branch → drop BERT/GNN →
+  trees+iforest → rules-only) and steps back up when the backlog drains,
+  reusing the per-branch validity/renormalization machinery in
+  ``ensemble/combine.py``.
+- ``plane``      — QosPlane: the bundle wired into ``serving/app.py`` and
+  ``stream/job.py``, publishing admitted/shed/ladder metrics through
+  ``obs/metrics.py``'s Prometheus exposition.
+- ``drill``      — a deterministic overload drill (virtual clock, real
+  batcher/job path) used by ``rtfd qos-drill`` and the tier-1 tests.
+"""
+
+from realtime_fraud_detection_tpu.qos.admission import (  # noqa: F401
+    AdmissionController,
+    AdmissionDecision,
+    PRIORITIES,
+    TokenBucket,
+)
+from realtime_fraud_detection_tpu.qos.budget import LatencyBudget  # noqa: F401
+from realtime_fraud_detection_tpu.qos.ladder import (  # noqa: F401
+    DegradationLadder,
+    LADDER_LEVELS,
+    LadderConfig,
+    LadderLevel,
+)
+from realtime_fraud_detection_tpu.qos.plane import QosPlane  # noqa: F401
+from realtime_fraud_detection_tpu.qos.drill import (  # noqa: F401
+    DrillScorer,
+    run_overload_drill,
+)
+
+__all__ = [
+    "DrillScorer",
+    "run_overload_drill",
+    "AdmissionController",
+    "AdmissionDecision",
+    "DegradationLadder",
+    "LADDER_LEVELS",
+    "LadderConfig",
+    "LadderLevel",
+    "LatencyBudget",
+    "PRIORITIES",
+    "QosPlane",
+    "TokenBucket",
+]

@@ -1,0 +1,126 @@
+"""The degradation ladder: trade ensemble quality for latency, reversibly.
+
+Under sustained backlog the full 5-branch ensemble is the wrong program to
+run — every batch scored at full cost pushes the queue (and every waiter's
+latency) further out. The ladder steps the ensemble DOWN one rung at a time:
+
+    0  full_ensemble   all 5 branches
+    1  no_text_graph   drop BERT + GNN (the two heavy branches)
+    2  trees_iforest   XGBoost + isolation forest only
+    3  rules_only      the §rule ladder alone — no learned branch
+
+and back UP when the backlog drains. Each rung is just a branch-validity
+mask: the fused program's per-branch ``valid`` input renormalizes the blend
+over the surviving branches (ensemble/combine.py) with ZERO recompiles —
+degrading is a runtime tensor change, exactly like a branch failure.
+
+Hysteresis: a step (either direction) requires ``patience`` CONSECUTIVE
+observations past the watermark, and the high/low watermarks are separated,
+so a backlog oscillating around one threshold cannot flap the ensemble.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import FrozenSet, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["LadderLevel", "LADDER_LEVELS", "LadderConfig",
+           "DegradationLadder"]
+
+
+@dataclasses.dataclass(frozen=True)
+class LadderLevel:
+    name: str
+    dropped_branches: FrozenSet[str]
+    rules_only: bool = False
+
+
+LADDER_LEVELS: Tuple[LadderLevel, ...] = (
+    LadderLevel("full_ensemble", frozenset()),
+    LadderLevel("no_text_graph", frozenset({"bert_text", "graph_neural"})),
+    LadderLevel("trees_iforest",
+                frozenset({"bert_text", "graph_neural", "lstm_sequential"})),
+    LadderLevel("rules_only",
+                frozenset({"xgboost_primary", "lstm_sequential", "bert_text",
+                           "graph_neural", "isolation_forest"}),
+                rules_only=True),
+)
+
+
+@dataclasses.dataclass
+class LadderConfig:
+    """Watermarks are in BACKLOG RECORDS (consumer lag + in-flight)."""
+
+    high_backlog: float = 2048.0   # sustained above this -> step down
+    low_backlog: float = 256.0     # sustained below this -> step up
+    patience: int = 2              # consecutive observations to step DOWN
+    # recovery is deliberately slower than degradation (None = patience):
+    # stepping down buys capacity immediately, but stepping up hands it
+    # back — under a sustained overload a symmetric ladder would flap
+    # degrade→drain→recover→backlog every few batches, and each recovery
+    # buys a fresh queueing spike straight out of the latency budget
+    up_patience: Optional[int] = None
+    max_level: int = len(LADDER_LEVELS) - 1
+
+
+class DegradationLadder:
+    """Observe the backlog, return the current level. Pure host state —
+    observations are explicit calls, so the drill drives it on a virtual
+    clock and production drives it once per dispatched microbatch."""
+
+    def __init__(self, config: LadderConfig = None):
+        self.config = config or LadderConfig()
+        self.level = 0
+        self.transitions_down = 0
+        self.transitions_up = 0
+        self._over = 0
+        self._under = 0
+
+    @property
+    def current(self) -> LadderLevel:
+        return LADDER_LEVELS[self.level]
+
+    def observe(self, backlog: float) -> int:
+        c = self.config
+        if backlog > c.high_backlog:
+            self._over += 1
+            self._under = 0
+            if self._over >= c.patience and self.level < c.max_level:
+                self.level += 1
+                self.transitions_down += 1
+                self._over = 0
+        elif backlog <= c.low_backlog:   # inclusive: a fully drained (0)
+            # backlog must count as low even when low_backlog is 0
+            self._under += 1
+            self._over = 0
+            up_patience = (c.up_patience if c.up_patience is not None
+                           else c.patience)
+            if self._under >= up_patience and self.level > 0:
+                self.level -= 1
+                self.transitions_up += 1
+                self._under = 0
+        else:
+            # the hysteresis band: hold the level, reset both streaks
+            self._over = 0
+            self._under = 0
+        return self.level
+
+    def level_mask(self, model_names: Sequence[str]) -> np.ndarray:
+        """Branch-validity mask for the CURRENT level over ``model_names``
+        (and-ed with the deployment's own validity in the scorer)."""
+        dropped = self.current.dropped_branches
+        return np.asarray([n not in dropped for n in model_names], bool)
+
+    def snapshot(self) -> dict:
+        return {
+            "level": self.level,
+            "level_name": self.current.name,
+            "rules_only": self.current.rules_only,
+            "transitions_down": self.transitions_down,
+            "transitions_up": self.transitions_up,
+            "high_backlog": self.config.high_backlog,
+            "low_backlog": self.config.low_backlog,
+            "patience": self.config.patience,
+        }

@@ -87,6 +87,11 @@ class PendingScore:
     # includes queue wait (the caller is off assembling the next batch), so
     # finalize() measures its own device wait and adds this — never the gap.
     dispatch_ms: float
+    # The branch-validity mask and rules-only flag THIS batch was dispatched
+    # under: the QoS ladder may step between dispatch and finalize, and the
+    # response must describe the program that actually ran.
+    model_valid: Optional[np.ndarray] = None
+    rules_only: bool = False
 
 
 class _EntityIndex:
@@ -183,6 +188,12 @@ class FraudScorer:
         self.model_valid = np.asarray(
             [n in enabled for n in MODEL_NAMES], bool
         )
+        # QoS degradation (qos/ladder.py): an extra mask AND-ed over the
+        # deployment validity, set per ladder rung; rules-only replaces the
+        # ensemble output with the rule score host-side
+        self._qos_mask: Optional[np.ndarray] = None
+        self._qos_rules_only = False
+        self.qos_level = 0
 
         # streaming state (the Redis-equivalent plane, SURVEY.md §2.5).
         # Default: in-process single-writer stores (state lives with the
@@ -257,14 +268,34 @@ class FraudScorer:
                       merchants: Mapping[str, Mapping[str, Any]]) -> None:
         self.profiles.seed(users, merchants)
 
-    def _model_valid_dev(self):
+    def _model_valid_dev(self, mv: Optional[np.ndarray] = None):
         """Device copy of the branch-validity mask, re-pushed only when the
         mask changes — not one h2d transfer per microbatch."""
         cached = getattr(self, "_mv_cache", None)
-        mv = np.asarray(self.model_valid)
+        if mv is None:
+            mv = self.effective_model_valid()
+        mv = np.asarray(mv)
         if cached is None or not np.array_equal(cached[0], mv):
             self._mv_cache = (mv.copy(), jax.device_put(mv))
         return self._mv_cache[1]
+
+    # ---------------------------------------------------------- degradation
+    def set_degradation(self, mask: Optional[np.ndarray],
+                        rules_only: bool = False, level: int = 0) -> None:
+        """Apply a QoS ladder rung: ``mask`` narrows the enabled-branch set
+        for subsequent dispatches (None = full ensemble); ``rules_only``
+        swaps the served score for the rule score at response build. Cheap
+        host-field writes — the fused program takes validity as a runtime
+        tensor, so stepping the ladder never recompiles."""
+        self._qos_mask = None if mask is None else np.asarray(mask, bool)
+        self._qos_rules_only = bool(rules_only)
+        self.qos_level = int(level)
+
+    def effective_model_valid(self) -> np.ndarray:
+        """Deployment validity AND the current QoS rung's mask."""
+        if self._qos_mask is None:
+            return np.asarray(self.model_valid)
+        return np.asarray(self.model_valid) & self._qos_mask
 
     # ----------------------------------------------------------------- models
     def set_feature_importances(self, importances) -> None:
@@ -415,10 +446,12 @@ class FraudScorer:
         blobs, spec = pack_tree(padded)
         sharded = shard_batch(self.mesh, blobs)
 
+        mv = self.effective_model_valid()
+        rules_only = self._qos_rules_only
         out = score_fused_packed(
             self.models, sharded["f32"], sharded["i32"], sharded["u8"],
             spec=spec, params=self.ensemble_params,
-            model_valid=self._model_valid_dev(),
+            model_valid=self._model_valid_dev(mv),
             blob_bf16=sharded["bf16"],
             bert_config=self.bert_config, use_pallas=self.sc.use_pallas,
         )
@@ -433,7 +466,8 @@ class FraudScorer:
                 pass
         return PendingScore(records=list(records), n=n, out=out,
                             features=self.last_features,
-                            dispatch_ms=(time.perf_counter() - t0) * 1000.0)
+                            dispatch_ms=(time.perf_counter() - t0) * 1000.0,
+                            model_valid=mv, rules_only=rules_only)
 
     def finalize(self, pending: "PendingScore", now: Optional[float] = None,
                  lock=None) -> List[Dict[str, Any]]:
@@ -454,7 +488,9 @@ class FraudScorer:
         elapsed_ms = (pending.dispatch_ms
                       + (time.perf_counter() - t_fin) * 1000.0)
         results = self._build_responses(pending.records, out, pending.n,
-                                        elapsed_ms)
+                                        elapsed_ms,
+                                        model_valid=pending.model_valid,
+                                        rules_only=pending.rules_only)
         with (lock if lock is not None else contextlib.nullcontext()):
             self._write_back(pending.records, results, now)
             self.stats["scored"] += pending.n
@@ -467,9 +503,13 @@ class FraudScorer:
         """Score transaction dicts -> FraudPrediction dicts (§2.7 schema)."""
         return self.finalize(self.dispatch(records, now), now)
 
-    def _build_responses(self, records, out, n, elapsed_ms) -> List[Dict[str, Any]]:
+    def _build_responses(self, records, out, n, elapsed_ms,
+                         model_valid=None,
+                         rules_only=False) -> List[Dict[str, Any]]:
         # ``out`` is the packed f32[B, 8+M] matrix from score_fused_packed:
         # OUT_COLUMNS then per-model predictions (one d2h transfer's worth).
+        if model_valid is None:
+            model_valid = self.model_valid
         mat = np.asarray(out)[:n]
         col = {name: mat[:, j] for j, name in enumerate(OUT_COLUMNS)}
         probs = col["fraud_probability"]
@@ -478,6 +518,30 @@ class FraudScorer:
         risk = col["risk_level"].astype(np.int32)
         preds = mat[:, len(OUT_COLUMNS):]
         rule = col["rule_score"]
+        if rules_only:
+            # the ladder's last rung: no learned branch survives; serve the
+            # rule score with the decision/risk ladders recomputed host-side
+            # (the device combine saw zero valid branches). Confidence is
+            # 1.0 — the rule ladder is deterministic, and anything under
+            # the confidence threshold would force every decision to REVIEW.
+            from realtime_fraud_detection_tpu.features.rules import (
+                APPROVE,
+                APPROVE_WITH_MONITORING,
+                DECLINE,
+                REVIEW,
+                risk_level_codes_np,
+            )
+
+            p = self.ensemble_params
+            probs = rule
+            conf = np.ones_like(probs)
+            decisions = np.where(
+                probs >= p.decline_threshold, DECLINE,
+                np.where(probs >= p.review_threshold, REVIEW,
+                         np.where(probs >= p.monitor_threshold,
+                                  APPROVE_WITH_MONITORING,
+                                  APPROVE))).astype(np.int32)
+            risk = risk_level_codes_np(probs)
         high_amount = col["high_amount"] > 0.5
         unusual_hour = col["unusual_hour"] > 0.5
         high_risk_payment = col["high_risk_payment"] > 0.5
@@ -489,7 +553,7 @@ class FraudScorer:
         for i, rec in enumerate(records):
             model_predictions = {
                 name: float(preds[i, j])
-                for j, name in enumerate(MODEL_NAMES) if self.model_valid[j]
+                for j, name in enumerate(MODEL_NAMES) if model_valid[j]
             }
             if with_explanation:
                 factors = []
@@ -502,13 +566,15 @@ class FraudScorer:
                 contributions = {
                     name: float(weights[j] * preds[i, j])
                     for j, name in enumerate(MODEL_NAMES)
-                    if self.model_valid[j]
+                    if model_valid[j]
                 }
                 explanation = {
                     "model_contributions": contributions,
                     "key_factors": factors,
                     "rule_score": float(rule[i]),
                 }
+                if rules_only:
+                    explanation["degraded"] = "rules_only"
                 if self._top_importances is not None:
                     # fresh dict per response: a consumer mutating one
                     # explanation must not corrupt its batch-mates

@@ -67,10 +67,18 @@ class ServingApp:
         self.drift = FeatureDriftMonitor(DriftConfig(
             num_features=self.scorer.sc.feature_dim))
         self.ab = ABTestManager()
+        # deadline-aware QoS plane (qos/): always constructed so /qos can
+        # enable it at runtime; admission/ladder only act when enabled.
+        # Shares this app's MetricsCollector, so admitted/shed/ladder
+        # series ride the existing Prometheus exposition.
+        from realtime_fraud_detection_tpu.qos import QosPlane
+
+        self.qos = QosPlane(self.config.qos, metrics=self.metrics)
         self.batcher = RequestMicrobatcher(
             self._score_batch_sync,
             max_batch=sc.microbatch_max_size,
             deadline_ms=sc.microbatch_deadline_ms,
+            budget=self.qos.budget if self.config.qos.enabled else None,
         )
         self.http = HttpServer(host if host is not None else sc.host,
                                port if port is not None else sc.port)
@@ -227,6 +235,8 @@ class ServingApp:
         r("GET", "/drift", self._drift)
         r("POST", "/experiments", self._create_experiment)
         r("GET", "/experiments", self._experiment_results)
+        r("GET", "/qos", self._qos_status)
+        r("POST", "/qos", self._qos_configure)
 
     def _admit(self, n: int) -> None:
         limit = self.config.serving.max_concurrent_predictions
@@ -253,6 +263,17 @@ class ServingApp:
         txn, errors = validate_transaction(body)
         if errors:
             raise HttpError(422, errors)
+        if self.qos.enabled:
+            # QoS admission ahead of the concurrency gate: a shed is an
+            # explicit score-with-reason (200, decision REVIEW, risk_level
+            # SHED), so retriable overload is visible to the caller without
+            # looking like record loss. The ladder observes the batcher
+            # queue depth as its backlog signal.
+            decision = self.qos.admit(txn, time.monotonic())
+            if not decision.admitted:
+                return 200, self.qos.shed_result(txn, decision)
+            self.qos.observe_backlog(self.batcher.queue_depth)
+            self.qos.apply_degradation(self.scorer)
         timeout = self.config.serving.prediction_timeout_seconds
         self._admit(1)
         try:
@@ -262,6 +283,7 @@ class ServingApp:
             self.metrics.record_error("at_capacity")
             raise HttpError(503, "scoring queue full")
         self._release_on_done(fut, 1)
+        t_enq = time.monotonic()
         try:
             # shield: the waiter's timeout must not cancel the scoring —
             # the batch containing this txn is already (or will be) on the
@@ -271,6 +293,8 @@ class ServingApp:
         except asyncio.TimeoutError:
             self.metrics.record_error("timeout")
             raise HttpError(408, "prediction timed out")
+        if self.qos.enabled:
+            self.qos.record_completion(t_enq, time.monotonic())
         self.metrics.queue_depth.set(self.batcher.queue_depth)
         return 200, result
 
@@ -342,20 +366,26 @@ class ServingApp:
         async with self._reload_lock:
             loop = asyncio.get_running_loop()
             source: Dict[str, Any] = {}
-            if "quality_artifact" in body:
+            blend_requested = "quality_artifact" in body
+            if blend_requested:
+                # VALIDATE the artifact up front (parse + schema + known
+                # branch names) but apply it only AFTER the checkpoint
+                # restore succeeds: a 404/409 restore must leave the live
+                # blend untouched, and a half-applied update (new blend +
+                # old params, or vice versa) must never serve.
                 try:
-                    applied = self.config.apply_quality_artifact(
+                    weights = Config.load_selected_blend_weights(
                         str(body["quality_artifact"]))
                 except FileNotFoundError as e:
                     raise HttpError(404, str(e))
                 except (ValueError, OSError) as e:
                     raise HttpError(422, str(e))
-                with self._score_lock:
-                    self.scorer.refresh_blend_from_config()
-                source["quality_artifact"] = {
-                    "path": str(body["quality_artifact"]),
-                    "weights": applied,
-                }
+                unknown = [n for n in weights
+                           if n not in self.config.models]
+                if unknown:
+                    raise HttpError(
+                        422, f"artifact names unknown model(s) {unknown}; "
+                             f"configured: {sorted(self.config.models)}")
             if "checkpoint_dir" in body:
                 step = body.get("step")
                 if step is not None:
@@ -380,7 +410,7 @@ class ServingApp:
                     raise HttpError(409, str(e))   # config/shape mismatch
                 source.update(checkpoint=body["checkpoint_dir"],
                               step=ck.step)
-            elif "quality_artifact" in body:
+            elif blend_requested:
                 pass                               # blend-only reload
             else:
                 import jax
@@ -397,12 +427,62 @@ class ServingApp:
                         self.scorer.set_models(fresh)
                 await loop.run_in_executor(None, _reinit)
                 source["reinit_seed"] = seed
+            if blend_requested:
+                # params are in place; deploy the (pre-validated) blend.
+                # Belt and suspenders: if the apply still fails, roll the
+                # model table back and refresh, so the served blend is
+                # either fully the old one or fully the new one.
+                snapshot = {n: (mc.enabled, mc.weight)
+                            for n, mc in self.config.models.items()}
+                try:
+                    applied = self.config.apply_quality_artifact(
+                        str(body["quality_artifact"]))
+                    with self._score_lock:
+                        self.scorer.refresh_blend_from_config()
+                except Exception:
+                    for name, (was_enabled, was_weight) in snapshot.items():
+                        mc = self.config.models[name]
+                        mc.enabled = was_enabled
+                        mc.weight = was_weight
+                    with self._score_lock:
+                        self.scorer.refresh_blend_from_config()
+                    raise
+                source["quality_artifact"] = {
+                    "path": str(body["quality_artifact"]),
+                    "weights": applied,
+                }
             if self.prediction_cache is not None:
                 # cached responses describe the replaced models; clear()
                 # keeps the monotonic hit/miss counters /health exposes
                 with self._score_lock:
                     self.prediction_cache.clear()
         return 200, {"status": "reloaded", "source": source}
+
+    async def _qos_status(self, body, query) -> Tuple[int, Any]:
+        """QoS plane status: ladder level, admission state, counters."""
+        snap = self.qos.snapshot()
+        snap["queue_depth"] = self.batcher.queue_depth
+        return 200, snap
+
+    async def _qos_configure(self, body, query) -> Tuple[int, Any]:
+        """Update QoS knobs at runtime (all runtime tensors/host state —
+        zero recompiles). Body: any subset of utils.config.QosSettings
+        fields, e.g. {"enabled": true, "admission_rate": 20000,
+        "budget_ms": 20}."""
+        body = body or {}
+        try:
+            applied = self.qos.configure(body)
+        except (TypeError, ValueError) as e:
+            raise HttpError(422, str(e))
+        # the budget only binds the batcher while the plane is enabled
+        self.batcher.budget = (self.qos.budget
+                               if self.config.qos.enabled else None)
+        if not self.config.qos.enabled:
+            # dropping back to a disabled plane also lifts any degradation
+            with self._score_lock:
+                self.scorer.set_degradation(None)
+        return 200, {"status": "configured", "applied": applied,
+                     "qos": self.qos.snapshot()}
 
     async def _drift(self, body, query) -> Tuple[int, Any]:
         rep = self.drift.report()

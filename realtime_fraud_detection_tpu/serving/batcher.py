@@ -12,6 +12,12 @@ request, then runs ONE fused scoring call in a worker thread (the event loop
 never blocks on device work). Every waiter gets its own row's
 FraudPrediction. Deadline defaults to 5 ms — the p99 < 20 ms budget allots
 assemble ≈ 5, transfer+compute ≈ 10, return ≈ 5 (SURVEY.md §7.6).
+
+With a QoS ``budget`` (qos/budget.py) attached, the batch close deadline is
+additionally capped by the OLDEST waiter's remaining latency budget: a
+request that already spent most of its budget queued closes its batch
+early (possibly at size 1) instead of waiting out the full assembly window
+on top — the deadline-aware assembly lever for p99 (arXiv:1904.07421).
 """
 
 from __future__ import annotations
@@ -32,10 +38,14 @@ class RequestMicrobatcher:
         max_batch: int = 256,
         deadline_ms: float = 5.0,
         max_queue: int = 10_000,
+        budget=None,
     ):
         self.score_fn = score_fn
         self.max_batch = max_batch
         self.deadline_s = deadline_ms / 1e3
+        # optional qos.LatencyBudget: per-request enqueue timestamps bound
+        # the close deadline by the oldest waiter's remaining budget
+        self.budget = budget
         self._queue: asyncio.Queue = asyncio.Queue(maxsize=max_queue)
         self._task: Optional[asyncio.Task] = None
         self._closed = False
@@ -72,7 +82,7 @@ class RequestMicrobatcher:
         if self._closed:
             raise RuntimeError("microbatcher is stopped")
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        self._queue.put_nowait((txn, fut))
+        self._queue.put_nowait((txn, fut, time.monotonic()))
         return fut
 
     async def submit(self, txn: Mapping[str, Any]) -> Dict[str, Any]:
@@ -80,10 +90,19 @@ class RequestMicrobatcher:
         if self._closed:
             raise RuntimeError("microbatcher is stopped")
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        await self._queue.put((txn, fut))
+        await self._queue.put((txn, fut, time.monotonic()))
         return await fut
 
     # ---------------------------------------------------------------- drain
+    def _close_at(self, first_item) -> float:
+        """When must the batch containing ``first_item`` hand off? The
+        assembly window from now, capped by the oldest waiter's remaining
+        latency budget (it is the oldest: the queue is FIFO)."""
+        deadline = time.monotonic() + self.deadline_s
+        if self.budget is not None:
+            deadline = min(deadline, self.budget.close_by(first_item[2]))
+        return deadline
+
     async def _drain(self) -> None:
         loop = asyncio.get_running_loop()
         while True:
@@ -92,7 +111,7 @@ class RequestMicrobatcher:
                 await self._flush_remaining(loop)
                 return
             batch = [first]
-            deadline = time.monotonic() + self.deadline_s
+            deadline = self._close_at(first)
             while len(batch) < self.max_batch:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
@@ -122,8 +141,8 @@ class RequestMicrobatcher:
             await self._score(loop, leftovers[i:i + self.max_batch])
 
     async def _score(self, loop, batch) -> None:
-        txns = [t for t, _ in batch]
-        futs = [f for _, f in batch]
+        txns = [t for t, _, _ in batch]
+        futs = [f for _, f, _ in batch]
         try:
             # device work off the event loop; one fused program per batch
             results = await loop.run_in_executor(

@@ -66,6 +66,12 @@ class JobConfig:
     # to D-1 batches' updates (vs 1 at the default depth 2). Raise depth for
     # throughput soaks; keep 2 where freshest velocity features matter.
     pipeline_depth: int = 2
+    # deadline-aware QoS plane (qos/): admission control, per-transaction
+    # latency budgets (the assembler closes batches early when the oldest
+    # waiter's budget runs low), and the degradation ladder fed by the
+    # backlog signal (consumer lag + pipelined in-flight records). None or
+    # enabled=False = the plane is off, behavior unchanged.
+    qos: Optional[Any] = None            # utils.config.QosSettings
     # topic names (reference JobConfig.java topic parameters); defaults are
     # the §2.5 contract (stream/topics.py) — overridable per deployment,
     # e.g. the reference's test-transactions topic for shadow traffic
@@ -95,6 +101,10 @@ class _BatchCtx:
     # silently swallowing it. Predictions are thereby at-least-once while
     # scoring + state stay effectively-once (consumers dedupe by txn id).
     cached_dups: List[tuple] = dataclasses.field(default_factory=list)
+    # QoS admission sheds: (record, AdmissionDecision) pairs. Each gets an
+    # explicit score-with-reason on the predictions topic at completion —
+    # a shed is a recorded decision, never a silent drop.
+    shed: List[tuple] = dataclasses.field(default_factory=list)
 
 
 class StreamJob:
@@ -121,17 +131,27 @@ class StreamJob:
         self.consumer = broker.consumer(
             [self.config.transactions_topic], self.config.group_id, faults
         )
+        # QoS plane: admission + ladder + budget (qos/plane.py); the
+        # assembler consults the budget so batches close early when the
+        # oldest waiter's remaining deadline drops under the margin
+        self.qos = None
+        qs = self.config.qos
+        if qs is not None and getattr(qs, "enabled", False):
+            from realtime_fraud_detection_tpu.qos import QosPlane
+
+            self.qos = qs if isinstance(qs, QosPlane) else QosPlane(qs)
         self.assembler = MicrobatchAssembler(
             self.consumer,
             max_batch=self.config.max_batch,
             max_delay_ms=self.config.max_delay_ms,
+            budget=self.qos.budget if self.qos is not None else None,
         )
         self.analytics = (
             WindowedAnalytics(broker) if self.config.enable_analytics else None
         )
         self.counters: Dict[str, int] = {
             "scored": 0, "alerts": 0, "batches": 0, "duplicates_skipped": 0,
-            "errors": 0,
+            "errors": 0, "shed": 0,
         }
         # transaction_ids dispatched but not yet written back: the pipelined
         # loop dedupes batch N+1 against these before batch N lands in the
@@ -160,7 +180,9 @@ class StreamJob:
         fresh: List[Record] = []
         invalid: List[tuple] = []
         cached_dups: List[tuple] = []
+        shed: List[tuple] = []
         batch_ids: set = set()
+        t_adm = now if now is not None else time.time()
         for r in records:
             txn, errors = sanitize_for_stream(r.value)
             if errors:
@@ -187,12 +209,31 @@ class StreamJob:
                 batch_ids.add(txn_id)
                 cached_dups.append((r, cached))
                 continue
+            if self.qos is not None:
+                # admission AFTER dedupe (a replayed duplicate must not
+                # burn tokens) and BEFORE dispatch: a shed is an explicit
+                # decision recorded at completion, never a silent drop
+                decision = self.qos.admit(txn, t_adm)
+                if not decision.admitted:
+                    self.counters["shed"] += 1
+                    shed.append((dataclasses.replace(r, value=txn),
+                                 decision))
+                    continue
             batch_ids.add(txn_id)
             fresh.append(dataclasses.replace(r, value=txn))
         positions = self.consumer.snapshot_positions()
+        if self.qos is not None:
+            # backlog signal, one ladder observation per dispatched
+            # microbatch: consumer lag counts everything not yet COMMITTED
+            # — the unread topic backlog plus every pipelined in-flight
+            # batch (commit happens at completion) — minus THIS batch,
+            # which is being handled right now, not waiting
+            self.qos.observe_backlog(
+                max(0, self.consumer.lag() - len(records)))
+            self.qos.apply_degradation(self.scorer)
         if not fresh:
             return _BatchCtx([], set(), None, positions, now, invalid,
-                             cached_dups)
+                             cached_dups, shed)
         pending = None
         try:
             pending = self.scorer.dispatch([r.value for r in fresh], now=now)
@@ -202,14 +243,24 @@ class StreamJob:
             pass
         self._inflight_ids |= batch_ids
         return _BatchCtx(fresh, batch_ids, pending, positions, now, invalid,
-                         cached_dups)
+                         cached_dups, shed)
 
-    def complete_batch(self, ctx: "_BatchCtx") -> List[Dict[str, Any]]:
-        """Stage 2: block on the device result, fan out, commit offsets."""
+    def complete_batch(self, ctx: "_BatchCtx",
+                       now: Optional[float] = None) -> List[Dict[str, Any]]:
+        """Stage 2: block on the device result, fan out, commit offsets.
+
+        ``now`` is the COMPLETION time (for QoS budget accounting on the
+        drill's virtual clock); ``ctx.now`` remains the dispatch-time
+        event clock for state TTLs. Default None = wall clock.
+        """
         cfg = self.config
-        fresh, now = ctx.fresh, ctx.now
+        fresh = ctx.fresh
+        t_done = now if now is not None else (
+            ctx.now if ctx.now is not None else time.time())
+        now = ctx.now
         if not fresh:
             invalid_results = self._emit_invalid(ctx)  # no ids at risk
+            self._emit_shed(ctx)
             self._emit_cached_dups(ctx)
             self.consumer.commit(ctx.positions)
             return invalid_results
@@ -239,10 +290,20 @@ class StreamJob:
                 for r in fresh
             ]
 
+        if self.qos is not None:
+            self.qos.record_scored(len(fresh))
+            for r in fresh:
+                # budget headroom at completion, from the record's ingest
+                # timestamp (negative = deadline blown; explicit None
+                # check — t=0.0 is a legitimate virtual-clock timestamp)
+                self.qos.record_completion(
+                    r.timestamp if r.timestamp is not None else t_done,
+                    t_done)
         try:
             # inside the protective try: a produce failure here must release
             # the in-flight ids like any other fan-out failure
             invalid_results = self._emit_invalid(ctx)
+            self._emit_shed(ctx)
             self._emit_cached_dups(ctx)
             return invalid_results + self._fan_out(
                 ctx, fresh, results, feats, scored_ok, now)
@@ -281,6 +342,21 @@ class StreamJob:
             self.broker.produce_batch_keyed(self.config.predictions_topic,
                                             items)
         return results
+
+    def _emit_shed(self, ctx: "_BatchCtx") -> None:
+        """Produce an explicit score-with-reason for every shed record
+        (qos.QosPlane.shed_result): downstream sees a REVIEW with the shed
+        reason and priority class in the explanation — load shedding is an
+        auditable decision, not record loss. Covered by this batch's
+        offset commit."""
+        if not ctx.shed or self.qos is None:
+            return
+        items = []
+        for rec, decision in ctx.shed:
+            value = rec.value if isinstance(rec.value, dict) else {}
+            items.append((str(value.get("user_id", "")),
+                          self.qos.shed_result(value, decision)))
+        self.broker.produce_batch_keyed(self.config.predictions_topic, items)
 
     def _emit_cached_dups(self, ctx: "_BatchCtx") -> None:
         """Re-emit predictions for txn-cache duplicates from their cached

@@ -36,14 +36,24 @@ class MicrobatchAssembler:
         max_delay_ms: float = 5.0,
         clock: Callable[[], float] = time.monotonic,
         idle_sleep_s: float = 0.0005,
+        budget=None,
+        budget_clock: Callable[[], float] = time.time,
     ):
         self.consumer = consumer
         self.max_batch = max_batch
         self.max_delay_ms = max_delay_ms
         self.clock = clock
         self.idle_sleep_s = idle_sleep_s
+        # optional qos.LatencyBudget: a third close trigger — the OLDEST
+        # pending record's remaining latency budget (from its ingest
+        # timestamp) dropping under the assembly margin. ``budget_clock``
+        # must share the record timestamps' time base (wall clock in
+        # production, the virtual clock in the overload drill).
+        self.budget = budget
+        self.budget_clock = budget_clock
         self._pending: List[Record] = []
         self._first_ts: Optional[float] = None
+        self._oldest_event_ts: Optional[float] = None
         self.batches_emitted = 0
         self.records_emitted = 0
 
@@ -51,6 +61,14 @@ class MicrobatchAssembler:
         return (
             self._first_ts is not None
             and (self.clock() - self._first_ts) * 1000.0 >= self.max_delay_ms
+        )
+
+    def _budget_low(self) -> bool:
+        return (
+            self.budget is not None
+            and self._oldest_event_ts is not None
+            and self.budget.should_close(self._oldest_event_ts,
+                                         self.budget_clock())
         )
 
     def next_batch(self, block: bool = True,
@@ -67,10 +85,19 @@ class MicrobatchAssembler:
                 got = self.consumer.poll(self.max_batch - len(self._pending))
                 if got and self._first_ts is None:
                     self._first_ts = self.clock()
+                if got and self.budget is not None:
+                    # explicit None check: t=0.0 is a legitimate ingest
+                    # timestamp (the drill's virtual clock starts there)
+                    ts = min((r.timestamp if r.timestamp is not None
+                              else self.budget_clock()) for r in got)
+                    self._oldest_event_ts = (
+                        ts if self._oldest_event_ts is None
+                        else min(self._oldest_event_ts, ts))
                 self._pending.extend(got)
 
             if len(self._pending) >= self.max_batch or (
-                self._pending and self._deadline_passed()
+                self._pending
+                and (self._deadline_passed() or self._budget_low())
             ):
                 return self._emit()
 
@@ -83,6 +110,12 @@ class MicrobatchAssembler:
     def _emit(self) -> List[Record]:
         batch, self._pending = self._pending[: self.max_batch], self._pending[self.max_batch:]
         self._first_ts = self.clock() if self._pending else None
+        if self.budget is not None and self._pending:
+            self._oldest_event_ts = min(
+                (r.timestamp if r.timestamp is not None
+                 else self.budget_clock()) for r in self._pending)
+        else:
+            self._oldest_event_ts = None
         self.batches_emitted += 1
         self.records_emitted += len(batch)
         return batch

@@ -19,6 +19,7 @@ are pinned exact by tests/test_blend_eval.py.
 
 from __future__ import annotations
 
+import logging
 from typing import Dict, Tuple
 
 import jax
@@ -27,19 +28,80 @@ import numpy as np
 __all__ = ["platt_fit", "platt_apply", "calibrate_lstm_head",
            "calibrate_gnn_head", "calibrate_bert_head"]
 
+logger = logging.getLogger(__name__)
+
+
+def _bce(z: np.ndarray, y: np.ndarray, a: float, b: float) -> float:
+    p = 1.0 / (1.0 + np.exp(-(a * z + b)))
+    eps = 1e-12
+    return float(-(y * np.log(p + eps)
+                   + (1.0 - y) * np.log(1.0 - p + eps)).mean())
+
 
 def platt_fit(logits: np.ndarray, labels: np.ndarray,
-              iters: int = 500, lr: float = 0.1) -> Tuple[float, float]:
+              iters: int = 2000, lr: float = 0.1,
+              tol: float = 1e-7) -> Tuple[float, float]:
     """Fit (a, b) of ``p = sigmoid(a*z + b)`` by BCE gradient descent on
-    held-out logits. Deterministic, initialized at identity (a=1, b=0)."""
-    z = np.asarray(logits, np.float64)
-    y = np.asarray(labels, np.float64)
-    a, b = 1.0, 0.0
+    held-out logits. Deterministic, initialized at identity (a=1, b=0).
+
+    The fit runs on CENTERED/STANDARDIZED logits — class-weighted training
+    shifts the raw logit mean far from 0 (pos_weight ~16 ≈ +2.8 nats), and
+    on uncentered data the coupled (a, b) gradients crawl (the b step keeps
+    fighting the a step), leaving the fit far from converged at the
+    iteration cap; with a large shift the surface can even push ``a``
+    NEGATIVE, i.e. a branch-inverting miscalibration (round-5 advisor).
+    The standardized solution (a', b') folds back exactly:
+    ``a = a'/sd, b = b' - a'*mu/sd``.
+
+    Iterates to convergence (parameter step < ``tol``) and FALLS BACK TO
+    IDENTITY with a warning when the fit is unusable: fitted ``a <= 0``
+    (would invert the branch's ranking) or the BCE did not improve over
+    identity (the fit diverged or the tail slice is degenerate). Identity
+    folds are no-ops, so a bad calibration slice can never make a branch
+    worse than uncalibrated.
+    """
+    z = np.asarray(logits, np.float64).ravel()
+    y = np.asarray(labels, np.float64).ravel()
+    if z.size == 0:
+        logger.warning("platt_fit: empty calibration slice; "
+                       "falling back to identity")
+        return 1.0, 0.0
+    with np.errstate(invalid="ignore", over="ignore"):
+        mu = float(z.mean())
+        sd = float(z.std())
+    if not np.isfinite(mu) or not np.isfinite(sd):
+        logger.warning("platt_fit: non-finite logits; "
+                       "falling back to identity")
+        return 1.0, 0.0
+    if sd < 1e-12:
+        sd = 1.0           # constant logits: only b is identifiable
+    zs = (z - mu) / sd
+    # identity in STANDARDIZED space maps back to the identity transform
+    # of the raw logits: a'=sd, b'=mu  ->  a=1, b=0
+    a_s, b_s = sd, mu
     for _ in range(iters):
-        p = 1.0 / (1.0 + np.exp(-(a * z + b)))
+        p = 1.0 / (1.0 + np.exp(-(a_s * zs + b_s)))
         g = p - y
-        a -= lr * float((g * z).mean())
-        b -= lr * float(g.mean())
+        da = lr * float((g * zs).mean())
+        db = lr * float(g.mean())
+        a_s -= da
+        b_s -= db
+        if abs(da) < tol and abs(db) < tol:
+            break
+    # fold the standardization back into (a, b): a*z + b == a_s*zs + b_s
+    a = a_s / sd
+    b = b_s - a_s * mu / sd
+    if a <= 0.0:
+        logger.warning(
+            "platt_fit: fitted a=%.4f <= 0 would invert the branch's "
+            "ranking; falling back to identity", a)
+        return 1.0, 0.0
+    if _bce(z, y, a, b) > _bce(z, y, 1.0, 0.0):
+        logger.warning(
+            "platt_fit: fit did not improve BCE over identity "
+            "(%.5f vs %.5f); falling back to identity",
+            _bce(z, y, a, b), _bce(z, y, 1.0, 0.0))
+        return 1.0, 0.0
     return float(a), float(b)
 
 

@@ -180,8 +180,25 @@ def build_graph_dataset(
 def _calibration_split(n: int, frac: float = 0.1,
                        min_rows: int = 200) -> int:
     """Rows reserved at the stream TAIL for the Platt fit (temporal split:
-    calibrate on data later than anything trained on)."""
-    return max(min_rows, int(n * frac))
+    calibrate on data later than anything trained on).
+
+    Returns 0 (calibration DISABLED, with a warning) when the slice would
+    consume half or more of the dataset — on a tiny dataset the old
+    unconditional ``max(min_rows, ...)`` could swallow the whole training
+    set, leaving zero training rows (NaN pos_weight from an empty label
+    slice, a zero-row training loop). Calibration is an optional refinement;
+    training data is not.
+    """
+    n_cal = max(min_rows, int(n * frac))
+    if n_cal * 2 > n:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "calibration disabled: the tail slice (%d rows, min %d) would "
+            "consume >= half of the %d-row dataset; train on everything "
+            "and skip the Platt fit", n_cal, min_rows, n)
+        return 0
+    return n_cal
 
 
 def train_lstm(

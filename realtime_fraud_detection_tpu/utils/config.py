@@ -145,6 +145,64 @@ class MonitoringConfig:
 
 
 @dataclass
+class QosSettings:
+    """Deadline-aware QoS plane knobs (qos/): admission, budgets, ladder.
+
+    Disabled by default — the plane is opt-in per deployment (``serve
+    --qos``, ``run-job --qos``, or config/JSON overlay). All knobs are
+    runtime state to the plane: changing them via ``POST /qos`` never
+    recompiles anything.
+    """
+
+    enabled: bool = False
+    # per-transaction latency budget (the p99 contract) and the slice of it
+    # reserved for transfer+compute+return — assembly must close a batch
+    # margin_ms before the oldest waiter's deadline
+    budget_ms: float = 20.0
+    assemble_margin_ms: float = 2.0
+    # token-bucket admission: sustainable txn/s (0 = unlimited), bucket
+    # size (0 = one second of tokens), and the reserve fraction under
+    # which the low class sheds first
+    admission_rate: float = 0.0
+    admission_burst: float = 0.0
+    low_reserve_frac: float = 0.25
+    # priority classification by amount when the record carries no
+    # explicit "priority" field: >= high_value_amount -> high (never
+    # shed), < low_value_amount -> low (sheds first), else normal
+    high_value_amount: float = 500.0
+    low_value_amount: float = 25.0
+    # degradation ladder (qos/ladder.py): backlog watermarks in records,
+    # consecutive observations per step (the hysteresis)
+    ladder_enabled: bool = True
+    ladder_high_backlog: float = 2048.0
+    ladder_low_backlog: float = 256.0
+    ladder_patience: int = 2
+    # recovery (step-up) patience; 0 = same as ladder_patience. Recovery
+    # slower than degradation keeps a sustained overload from flapping the
+    # ensemble (each recovery buys a fresh queueing spike)
+    ladder_up_patience: int = 8
+
+    def validate(self) -> None:
+        """The QoS invariants — enforced at config load (Config.validate)
+        AND on every runtime update (QosPlane.configure), so POST /qos can
+        never put the plane into a state the loader would refuse."""
+        if self.budget_ms <= 0 or self.assemble_margin_ms < 0 \
+                or self.assemble_margin_ms >= self.budget_ms:
+            raise ValueError(
+                f"qos budget must satisfy 0 <= assemble_margin_ms < "
+                f"budget_ms, got margin={self.assemble_margin_ms} "
+                f"budget={self.budget_ms}")
+        if self.ladder_low_backlog > self.ladder_high_backlog:
+            # inverted watermarks would make the ladder step down and up
+            # on the SAME backlog — the flapping hysteresis exists to
+            # prevent
+            raise ValueError(
+                f"qos ladder watermarks must satisfy low_backlog <= "
+                f"high_backlog, got low={self.ladder_low_backlog} "
+                f"high={self.ladder_high_backlog}")
+
+
+@dataclass
 class StateConfig:
     """Windowed state store settings (RedisService.java key TTLs)."""
 
@@ -248,6 +306,7 @@ class Config:
     state: StateConfig = field(default_factory=StateConfig)
     sim: SimConfig = field(default_factory=SimConfig)
     monitoring: MonitoringConfig = field(default_factory=MonitoringConfig)
+    qos: QosSettings = field(default_factory=QosSettings)
 
     def __post_init__(self) -> None:
         self._apply_env()
@@ -381,6 +440,7 @@ class Config:
                 "review_threshold <= decline_threshold <= 1, got "
                 f"monitor={e.monitor_threshold} review={e.review_threshold} "
                 f"decline={e.decline_threshold}")
+        self.qos.validate()
 
 
 def _merge_dataclass(obj: Any, data: Dict[str, Any]) -> None:

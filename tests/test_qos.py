@@ -1,0 +1,318 @@
+"""QoS plane units: admission classes, ladder hysteresis, budgets, and the
+degradation seam into the real scorer."""
+
+import numpy as np
+import pytest
+
+from realtime_fraud_detection_tpu.qos import (
+    AdmissionController,
+    DegradationLadder,
+    LadderConfig,
+    LatencyBudget,
+    QosPlane,
+    TokenBucket,
+)
+from realtime_fraud_detection_tpu.utils.config import Config, QosSettings
+
+
+class TestAdmission:
+    def test_token_bucket_refills_at_rate(self):
+        b = TokenBucket(rate=10.0, burst=5.0)
+        b.refill(0.0)
+        for _ in range(5):
+            b.take()
+        assert b.tokens == 0.0
+        b.refill(0.25)                  # +2.5 tokens
+        assert b.tokens == pytest.approx(2.5)
+        b.refill(10.0)                  # capped at burst
+        assert b.tokens == 5.0
+
+    def test_high_never_shed_low_sheds_first(self):
+        c = AdmissionController(rate=10.0, burst=4.0, low_reserve_frac=0.25)
+        # drain the bucket with normal traffic at t=0
+        decisions = [c.decide("normal", 0.0) for _ in range(6)]
+        assert [d.admitted for d in decisions] == [True] * 4 + [False] * 2
+        assert decisions[-1].reason == "shed:rate_limit"
+        # empty bucket: high still admits (debt), low is refused with the
+        # reserve reason
+        assert c.decide("high", 0.0).admitted
+        low = c.decide("low", 0.0)
+        assert not low.admitted and low.reason == "shed:low_reserve"
+        # low needs the reserve to remain AFTER its own draw: at 1.9
+        # tokens (reserve = 1.0) it is still refused, normal admits
+        c2 = AdmissionController(rate=10.0, burst=4.0, low_reserve_frac=0.25)
+        c2.decide("normal", 0.0)
+        c2.decide("normal", 0.0)
+        c2.bucket.tokens = 1.9
+        assert not c2.decide("low", 0.0).admitted
+        assert c2.decide("normal", 0.0).admitted
+
+    def test_rate_zero_is_unlimited(self):
+        c = AdmissionController(rate=0.0)
+        for p in ("high", "normal", "low"):
+            d = c.decide(p, 0.0)
+            assert d.admitted and d.reason == "unlimited"
+
+
+class TestLadder:
+    def test_hysteresis_requires_consecutive_observations(self):
+        ladder = DegradationLadder(LadderConfig(
+            high_backlog=100, low_backlog=10, patience=2))
+        assert ladder.observe(500) == 0          # one high observation
+        assert ladder.observe(50) == 0           # streak broken (band)
+        assert ladder.observe(500) == 0
+        assert ladder.observe(500) == 1          # two consecutive -> down
+        assert ladder.transitions_down == 1
+        # recovery also needs the streak
+        assert ladder.observe(5) == 1
+        assert ladder.observe(50) == 1           # band resets
+        assert ladder.observe(5) == 1
+        assert ladder.observe(5) == 0
+        assert ladder.transitions_up == 1
+
+    def test_up_patience_slows_recovery(self):
+        ladder = DegradationLadder(LadderConfig(
+            high_backlog=100, low_backlog=10, patience=2, up_patience=5))
+        ladder.observe(500)
+        ladder.observe(500)
+        assert ladder.level == 1
+        for _ in range(4):
+            assert ladder.observe(0) == 1        # not yet
+        assert ladder.observe(0) == 0            # 5th consecutive low
+
+    def test_ladder_masks_follow_the_documented_rungs(self):
+        from realtime_fraud_detection_tpu.scoring.pipeline import MODEL_NAMES
+
+        ladder = DegradationLadder(LadderConfig(
+            high_backlog=1, low_backlog=0, patience=1))
+        masks = []
+        for _ in range(3):
+            ladder.observe(10)
+            masks.append(ladder.level_mask(MODEL_NAMES))
+        names = list(MODEL_NAMES)
+        # level 1: drop BERT + GNN
+        assert list(np.asarray(names)[~masks[0]]) == ["bert_text",
+                                                      "graph_neural"]
+        # level 2: trees + iforest only
+        assert set(np.asarray(names)[masks[1]]) == {"xgboost_primary",
+                                                    "isolation_forest"}
+        # level 3: rules only
+        assert not masks[2].any()
+        assert ladder.current.rules_only
+
+    def test_never_steps_past_the_ends(self):
+        ladder = DegradationLadder(LadderConfig(
+            high_backlog=1, low_backlog=0, patience=1))
+        for _ in range(10):
+            ladder.observe(100)
+        assert ladder.level == 3
+        for _ in range(10):
+            ladder.observe(0)
+        assert ladder.level == 0
+
+
+class TestBudget:
+    def test_remaining_and_close_by(self):
+        b = LatencyBudget(budget_ms=20.0, margin_ms=2.0)
+        assert b.remaining_ms(100.0, 100.0) == pytest.approx(20.0)
+        assert b.remaining_ms(100.0, 100.015) == pytest.approx(5.0)
+        assert b.remaining_ms(100.0, 100.025) == pytest.approx(-5.0)
+        assert not b.should_close(100.0, 100.017)
+        assert b.should_close(100.0, 100.0181)
+
+    def test_config_validates_budget_and_watermarks(self):
+        with pytest.raises(ValueError, match="assemble_margin_ms"):
+            Config(qos=QosSettings(budget_ms=5.0, assemble_margin_ms=5.0))
+        with pytest.raises(ValueError, match="watermarks"):
+            Config(qos=QosSettings(ladder_low_backlog=100,
+                                   ladder_high_backlog=10))
+
+
+class TestPlane:
+    def test_classify_by_amount_and_explicit_priority(self):
+        plane = QosPlane(QosSettings(high_value_amount=500,
+                                     low_value_amount=25))
+        assert plane.classify({"amount": 900}) == "high"
+        assert plane.classify({"amount": 100}) == "normal"
+        assert plane.classify({"amount": 5}) == "low"
+        assert plane.classify({"amount": 5, "priority": "high"}) == "high"
+        assert plane.classify({"amount": "garbage"}) == "low"
+
+    def test_shed_result_carries_reason_on_the_score_schema(self):
+        plane = QosPlane(QosSettings(enabled=True, admission_rate=1.0,
+                                     admission_burst=1.0))
+        txn = {"transaction_id": "t1", "amount": 5.0}
+        plane.admit(txn, 0.0)        # low: refused (reserve), counted
+        decision = plane.admission.decide("low", 0.0)
+        res = plane.shed_result(txn, decision)
+        for field in ("transaction_id", "fraud_probability", "fraud_score",
+                      "risk_level", "decision", "model_predictions",
+                      "confidence", "processing_time_ms", "explanation"):
+            assert field in res, field
+        assert res["risk_level"] == "SHED"
+        assert res["decision"] == "REVIEW"
+        assert res["explanation"]["shed"] is True
+        assert res["explanation"]["shed_reason"].startswith("shed:")
+        assert res["explanation"]["priority"] == "low"
+
+    def test_metrics_flow_to_prometheus_exposition(self):
+        plane = QosPlane(QosSettings(enabled=True, admission_rate=2.0,
+                                     admission_burst=2.0))
+        plane.admit({"amount": 900}, 0.0)     # high admitted
+        plane.admit({"amount": 5}, 0.0)       # low shed (reserve)
+        plane.observe_backlog(0)
+        text = plane.metrics.render_prometheus()
+        assert 'qos_admitted_total{priority="high"} 1' in text
+        assert 'qos_shed_total{priority="low",reason="shed:low_reserve"} 1' \
+            in text
+        assert "qos_ladder_level 0" in text
+        assert "qos_budget_remaining_seconds_bucket" in text
+
+    def test_configure_rejects_unknown_and_applies_known(self):
+        plane = QosPlane(QosSettings())
+        with pytest.raises(ValueError, match="unknown qos setting"):
+            plane.configure({"nope": 1})
+        applied = plane.configure({"enabled": True, "budget_ms": 15,
+                                   "admission_rate": 100})
+        assert applied == {"enabled": True, "budget_ms": 15.0,
+                           "admission_rate": 100.0}
+        assert plane.enabled
+        assert plane.budget.budget_ms == 15.0
+        assert plane.admission.bucket.rate == 100.0
+
+    def test_configure_rederives_burst_from_the_new_rate(self):
+        # a plane constructed unlimited (rate 0 -> burst 1) enabled at a
+        # real rate must get a real bucket, not keep the 1-token one
+        plane = QosPlane(QosSettings())
+        assert plane.admission.bucket.burst == 1.0
+        plane.configure({"enabled": True, "admission_rate": 20_000})
+        assert plane.admission.bucket.burst == 20_000.0
+        # an explicit burst still wins
+        plane.configure({"admission_burst": 500.0})
+        assert plane.admission.bucket.burst == 500.0
+
+    def test_configure_enforces_load_time_invariants(self):
+        plane = QosPlane(QosSettings())
+        with pytest.raises(ValueError, match="assemble_margin_ms"):
+            plane.configure({"assemble_margin_ms": 25.0})   # >= budget 20
+        assert plane.settings.assemble_margin_ms == 2.0     # rolled back
+        with pytest.raises(ValueError, match="watermarks"):
+            plane.configure({"ladder_low_backlog": 5000.0})
+        assert plane.settings.ladder_low_backlog == 256.0
+        with pytest.raises(ValueError, match="budget"):
+            plane.configure({"budget_ms": 0})
+
+    def test_configure_rejects_stringly_typed_booleans(self):
+        # bool("false") is True — a stringified boolean must 422, not
+        # silently enable the plane
+        plane = QosPlane(QosSettings())
+        with pytest.raises(ValueError, match="boolean"):
+            plane.configure({"enabled": "false"})
+        assert not plane.enabled
+        with pytest.raises(ValueError, match="number"):
+            plane.configure({"admission_rate": "100"})
+
+
+class TestScorerDegradation:
+    """The ladder seam into the REAL fused scorer: masks narrow the blend
+    with zero recompiles; rules-only serves the rule score."""
+
+    @pytest.fixture(scope="class")
+    def scorer(self):
+        from realtime_fraud_detection_tpu.scoring import (
+            FraudScorer,
+            ScorerConfig,
+        )
+        from realtime_fraud_detection_tpu.sim.simulator import (
+            TransactionGenerator,
+        )
+
+        gen = TransactionGenerator(num_users=16, num_merchants=8, seed=5)
+        s = FraudScorer(scorer_config=ScorerConfig(text_len=32))
+        s.seed_profiles(gen.users.profiles(), gen.merchants.profiles())
+        return s, gen
+
+    def test_mask_narrows_model_predictions(self, scorer):
+        from realtime_fraud_detection_tpu.scoring.pipeline import MODEL_NAMES
+
+        s, gen = scorer
+        txns = gen.generate_batch(4)
+        full = s.score_batch(txns, now=1000.0)
+        assert set(full[0]["model_predictions"]) == set(MODEL_NAMES)
+
+        mask = np.asarray([n not in ("bert_text", "graph_neural")
+                           for n in MODEL_NAMES])
+        s.set_degradation(mask, rules_only=False, level=1)
+        try:
+            degraded = s.score_batch(gen.generate_batch(4), now=1001.0)
+        finally:
+            s.set_degradation(None)
+        assert set(degraded[0]["model_predictions"]) == \
+            set(MODEL_NAMES) - {"bert_text", "graph_neural"}
+
+    def test_rules_only_serves_the_rule_score(self, scorer):
+        s, gen = scorer
+        txns = gen.generate_batch(4)
+        s.set_degradation(np.zeros(5, bool), rules_only=True, level=3)
+        try:
+            results = s.score_batch(txns, now=1002.0)
+        finally:
+            s.set_degradation(None)
+        for r in results:
+            assert r["model_predictions"] == {}
+            assert r["explanation"]["degraded"] == "rules_only"
+            # the served probability IS the rule score
+            assert r["fraud_probability"] == pytest.approx(
+                r["explanation"]["rule_score"], abs=1e-6)
+            assert r["confidence"] == 1.0
+            assert r["decision"] in ("APPROVE", "APPROVE_WITH_MONITORING",
+                                     "REVIEW", "DECLINE")
+
+
+class TestCalibrationFixes:
+    """Round-5 advisor satellites: platt_fit robustness + the calibration
+    split guard."""
+
+    def test_platt_fit_handles_shifted_logits(self):
+        from realtime_fraud_detection_tpu.training.calibrate import platt_fit
+
+        rng = np.random.default_rng(3)
+        # class-weighted regime: logit mean ~ +3 (pos_weight inflation)
+        z = rng.normal(3.0, 1.5, 4000)
+        y = (rng.random(4000) < 1 / (1 + np.exp(-(z - 3.5)))).astype(
+            np.float32)
+        a, b = platt_fit(z, y)
+        assert a == pytest.approx(1.0, abs=0.15)
+        assert b == pytest.approx(-3.5, abs=0.4)
+
+    def test_platt_fit_never_inverts_the_branch(self):
+        from realtime_fraud_detection_tpu.training.calibrate import platt_fit
+
+        # anti-correlated labels would fit a < 0 — the guard must fall
+        # back to identity rather than serve a branch-inverting transform
+        rng = np.random.default_rng(4)
+        z = rng.normal(0.0, 2.0, 1000)
+        y = (rng.random(1000) < 1 / (1 + np.exp(z))).astype(np.float32)
+        assert platt_fit(z, y) == (1.0, 0.0)
+
+    def test_platt_fit_degenerate_inputs_identity(self):
+        from realtime_fraud_detection_tpu.training.calibrate import platt_fit
+
+        assert platt_fit(np.array([]), np.array([])) == (1.0, 0.0)
+        assert platt_fit(np.array([np.inf, 1.0]),
+                         np.array([1.0, 0.0])) == (1.0, 0.0)
+
+    def test_calibration_split_disables_on_tiny_datasets(self):
+        from realtime_fraud_detection_tpu.training.neural import (
+            _calibration_split,
+        )
+
+        # big dataset: 10% tail (>= 200 rows)
+        assert _calibration_split(10_000) == 1000
+        assert _calibration_split(3000) == 300
+        # small dataset: min_rows floor would eat >= half -> disabled
+        assert _calibration_split(300) == 0
+        assert _calibration_split(200) == 0
+        assert _calibration_split(50) == 0
+        # just big enough: 401 rows leaves 201 training rows
+        assert _calibration_split(401) == 200

@@ -464,6 +464,86 @@ class TestEndpoints:
         finally:
             app.scorer.model_valid[idx] = was
 
+    def test_qos_status_and_runtime_configuration(self, app_server):
+        """GET /qos reports the plane; POST /qos flips knobs at runtime
+        (zero recompiles) and admission starts shedding low-priority
+        requests as explicit scores-with-reason."""
+        app, gen = app_server
+        status, snap = _request(app.port, "GET", "/qos")
+        assert status == 200
+        assert snap["enabled"] is False
+        assert snap["ladder"]["level"] == 0
+        assert snap["ladder_levels"] == ["full_ensemble", "no_text_graph",
+                                        "trees_iforest", "rules_only"]
+
+        status, _ = _request(app.port, "POST", "/qos", {"nope": 1})
+        assert status == 422
+
+        # enable with a starved bucket: low sheds immediately (reserve),
+        # high never sheds
+        status, data = _request(app.port, "POST", "/qos",
+                                {"enabled": True, "admission_rate": 0.001,
+                                 "admission_burst": 1.0})
+        assert status == 200
+        assert data["applied"]["enabled"] is True
+        try:
+            low = dict(_txn(gen), amount=5.0)
+            status, res = _request(app.port, "POST", "/predict", low)
+            assert status == 200
+            assert res["risk_level"] == "SHED"
+            assert res["decision"] == "REVIEW"
+            assert res["explanation"]["shed"] is True
+            assert res["explanation"]["shed_reason"].startswith("shed:")
+            assert res["explanation"]["priority"] == "low"
+            assert res["model_predictions"] == {}
+
+            high = dict(_txn(gen), amount=5000.0)
+            status, res = _request(app.port, "POST", "/predict", high)
+            assert status == 200
+            assert res["explanation"].get("shed") is None   # scored
+            assert res["model_predictions"]
+
+            status, snap = _request(app.port, "GET", "/qos")
+            assert snap["counters"]["shed"] >= 1
+            assert snap["counters"]["admitted"] >= 1
+            status, text = _request(app.port, "GET", "/metrics/prometheus")
+            assert "qos_shed_total" in text
+            assert 'priority="low"' in text
+        finally:
+            status, _ = _request(app.port, "POST", "/qos",
+                                 {"enabled": False, "admission_rate": 0.0})
+            assert status == 200
+
+    def test_reload_bad_checkpoint_leaves_blend_untouched(self, app_server,
+                                                          tmp_path):
+        """The /reload-models ordering fix: a combined body whose
+        checkpoint restore FAILS must leave the quality-artifact blend
+        unapplied — a half-applied update (new blend + old params) never
+        serves."""
+        import json as _json
+
+        app, _ = app_server
+        status, before = _request(app.port, "GET", "/model-info")
+        assert status == 200
+        artifact = tmp_path / "q.json"
+        artifact.write_text(_json.dumps({"selected_blend": {"weights": {
+            "xgboost_primary": 0.9, "isolation_forest": 0.1}}}))
+        status, _ = _request(app.port, "POST", "/reload-models",
+                             {"quality_artifact": str(artifact),
+                              "checkpoint_dir": str(tmp_path / "missing")})
+        assert status == 404                      # restore failed
+        status, after = _request(app.port, "GET", "/model-info")
+        assert after == before                    # blend untouched
+        # a malformed artifact fails the whole reload up front too
+        bad = tmp_path / "bad.json"
+        bad.write_text("{}")
+        status, _ = _request(app.port, "POST", "/reload-models",
+                             {"quality_artifact": str(bad),
+                              "checkpoint_dir": str(tmp_path / "missing")})
+        assert status == 422
+        status, after = _request(app.port, "GET", "/model-info")
+        assert after == before
+
     def test_drift_endpoint(self, app_server):
         app, _ = app_server
         status, data = _request(app.port, "GET", "/drift")

@@ -398,6 +398,87 @@ def test_poisoned_record_degrades_alone_not_the_batch():
     assert broker.lag(job.config.group_id, T.TRANSACTIONS) == 0
 
 
+def test_qos_overload_drill_ladder_shed_budget():
+    """THE overload acceptance drill (ISSUE 1): offered load 2x the
+    sustainable rate through the real assembler/job path on a virtual
+    clock. Must hold, deterministically, on CPU:
+
+    - the degradation ladder ENGAGES under overload and DISENGAGES with
+      hysteresis once the backlog drains (transitions visible in the
+      Prometheus exposition),
+    - only low-priority records are shed, every shed record carries an
+      explicit shed reason on the predictions topic,
+    - admitted transactions' p99 stays inside the configured budget.
+    """
+    from realtime_fraud_detection_tpu.qos import run_overload_drill
+
+    summary, job, plane = run_overload_drill(
+        offered_multiplier=2.0, overload_s=1.0, recovery_s=1.0,
+        budget_ms=20.0, seed=7, return_state=True)
+
+    # every produced record is accounted for: scored or explicitly shed
+    assert summary["scored"] + summary["shed"] == summary["produced"]
+    assert summary["shed"] > 0
+
+    # ladder engaged under overload and recovered after the drain
+    assert summary["max_ladder_level"] >= 1
+    ladder = summary["ladder"]
+    assert ladder["transitions_down"] >= 1
+    assert ladder["transitions_up"] >= 1
+    assert ladder["level"] == 0                  # fully recovered
+
+    # only low-priority records were shed (high never sheds by contract)
+    for key in summary["shed_by_priority_reason"]:
+        priority, _, reason = key.partition(":")
+        assert priority != "high", key
+        assert reason.startswith("shed:"), key
+
+    # admitted p99 inside the budget — the whole point of the plane
+    assert summary["admitted_latency_ms"]["p99"] <= summary["budget_ms"], \
+        summary["admitted_latency_ms"]
+
+    # the shed decisions are ON THE PREDICTIONS TOPIC as scores-with-reason
+    preds = job.broker.consumer(
+        [job.config.predictions_topic], "qos-check").poll(100_000)
+    shed_records = [p.value for p in preds
+                    if p.value.get("explanation", {}).get("shed")]
+    assert len(shed_records) == summary["shed"]
+    for rec in shed_records:
+        assert rec["explanation"]["shed_reason"].startswith("shed:")
+        assert rec["explanation"]["priority"] != "high"
+        assert rec["risk_level"] == "SHED"
+        assert rec["decision"] == "REVIEW"
+    # scored + shed predictions all arrived: nothing silently dropped
+    assert len(preds) == summary["produced"]
+
+    # ladder transitions are observable through the Prometheus exposition
+    text = plane.metrics.render_prometheus()
+    assert "qos_ladder_level" in text
+    down = [ln for ln in text.splitlines()
+            if ln.startswith('qos_ladder_transitions_total{direction="down"}')]
+    up = [ln for ln in text.splitlines()
+          if ln.startswith('qos_ladder_transitions_total{direction="up"}')]
+    assert down and int(float(down[0].split()[-1])) >= 1
+    assert up and int(float(up[0].split()[-1])) >= 1
+    assert "qos_shed_total" in text
+    assert "qos_budget_remaining_seconds_bucket" in text
+
+
+def test_qos_disabled_job_unchanged():
+    """JobConfig without qos: no plane, no shed counter movement, results
+    identical to the pre-QoS path."""
+    gen = TransactionGenerator(num_users=10, num_merchants=5, seed=43)
+    broker = InMemoryBroker()
+    scorer = FraudScorer(scorer_config=ScorerConfig(text_len=32))
+    scorer.seed_profiles(gen.users.profiles(), gen.merchants.profiles())
+    job = StreamJob(broker, scorer, JobConfig(max_batch=8))
+    assert job.qos is None
+    broker.produce_batch(T.TRANSACTIONS, gen.generate_batch(8),
+                         key_fn=lambda r: str(r["user_id"]))
+    assert job.run_until_drained(now=1000.0) == 8
+    assert job.counters["shed"] == 0
+
+
 def test_job_topics_configurable_default_contract():
     """Topic names flow from JobConfig (reference JobConfig.java topic
     params); defaults are the §2.5 contract. A renamed predictions topic
