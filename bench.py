@@ -142,6 +142,8 @@ def _compact_summary(result: dict) -> dict:
     e2e = result.get("e2e_stream") or {}
     quality = result.get("quality") or {}
     mfu = (result.get("mfu") or {}).get("mfu")
+    ha = result.get("host_assembly") or {}
+    overlap = ha.get("overlap") or {}
     compact = {
         "metric": result.get("metric", METRIC_NAME),
         "value": result.get("value", 0.0),
@@ -158,6 +160,12 @@ def _compact_summary(result: dict) -> dict:
                                  "p99_net_of_rtt_ms")}
                             if isinstance(op, dict) else None),
         "e2e_stream_txn_per_s": e2e.get("txn_per_s"),
+        "host_assembly": ({
+            "columnar_us_per_txn": ha.get("columnar_us_per_txn"),
+            "serial_us_per_txn": ha.get("serial_us_per_txn"),
+            "speedup_vs_serial": ha.get("speedup_vs_serial"),
+            "overlap_ratio": overlap.get("overlap_ratio"),
+        } if ha and not ha.get("error") else None),
         "quality": ({"auc": quality.get("auc"),
                      "accuracy": quality.get("accuracy")}
                     if quality else None),
@@ -180,7 +188,8 @@ def _compact_summary(result: dict) -> dict:
     line = json.dumps(compact, separators=(",", ":"))
     while len(line.encode()) >= 2048:
         for victim in ("configs_txn_per_s", "operating_point", "quality",
-                       "latest_committed_tpu_capture", "error"):
+                       "host_assembly", "latest_committed_tpu_capture",
+                       "error"):
             if compact.pop(victim, None) is not None:
                 break
         else:
@@ -819,6 +828,21 @@ def run_bench() -> None:
     snapshot("config4")
     _log('configs 1-5 done; all 5 BASELINE configs in the snapshot')
 
+    # ------------------------------------------------- host-assembly stage
+    # Columnar vs record-at-a-time assemble throughput + cache hit rates +
+    # (CPU) assembler-stage overlap. The assemble comparison is host-only
+    # (feature extraction is pinned to the CPU backend), so it is safe in
+    # the pre-pull regime and runs even when the TPU relay is down — the
+    # CPU bench sees the host-plane win regardless of the accelerator.
+    if remaining() > 45:
+        try:
+            _host_assembly_stage(result, on_tpu, remaining, snapshot)
+        except Exception as e:  # noqa: BLE001
+            result["host_assembly"] = {
+                "error": f"{type(e).__name__}: {e}"[:200]}
+        _log(f'host-assembly stage done: '
+             f'{ {k: v for k, v in (result.get("host_assembly") or {}).items() if not isinstance(v, dict)} }')
+
     # 3b. honest sequence lengths (VERDICT r3 missing-6): the reference
     # tokenizes at max_length 512 (bert_text_analyzer.py:201-202); seq 64
     # is the production truncation for short merchant/description strings.
@@ -867,10 +891,16 @@ def run_bench() -> None:
     lat: dict[str, dict] = {}
     sweep: dict[str, dict] = {}
     rtt_floor = (rtt or {}).get("p50_ms", 0.0)
-    sweep_buckets = BUCKETS if on_tpu else (1, 32, 256)
+    # Decision-relevant buckets FIRST (VERDICT r5 weak #6): 128/64 are the
+    # ones expected to pass the 20 ms budget, and two rounds of driver runs
+    # trimmed them because they sat at the tail — now a tight budget cuts
+    # the least informative buckets, on the CPU fallback included.
+    sweep_buckets = (128, 64, 32, 256, 1)
     for bsz in sweep_buckets:
-        if remaining() < 60 and bsz not in (32, 256):
-            continue
+        if remaining() < 60:
+            _log(f'bucket sweep: budget exhausted before b={bsz}; '
+                 f'trimming the tail')
+            break
         _log(f'bucket sweep b={bsz}')
         iters = it(100 if bsz >= 128 else 150)
         host_b, dev_b = batches[bsz], dev_batches[bsz]
@@ -1022,6 +1052,135 @@ def run_bench() -> None:
     _log(f'done: e2e_stream={result.get("e2e_stream")}; '
          f'quality={result.get("quality")}')
     print(json.dumps(result), flush=True)
+
+
+def _host_assembly_stage(result: dict, on_tpu: bool, remaining,
+                         snapshot) -> None:
+    """Deterministic host-assembly measurement (ISSUE 2 acceptance gate).
+
+    Reports assemble µs/txn for the columnar path vs the record-at-a-time
+    baseline (``FraudScorer.assemble_serial`` — the reference's per-request
+    loop cost profile, main.py:235-248) on identical record streams and
+    identically seeded state, plus token/entity cache hit rates and the
+    per-stage span breakdown. On CPU it additionally runs the overlapped
+    assembler stage head-to-head against the serial loop and reports the
+    overlap ratio (fraction of assembly wall-time hidden behind device
+    compute); on the tunneled TPU that soak would flip the process into
+    sync-dispatch mode, so it is skipped there (the e2e soak at the tail
+    covers pipelining on-chip).
+    """
+    import time as _time
+
+    from realtime_fraud_detection_tpu.scoring import (
+        FraudScorer,
+        ScorerConfig,
+    )
+    from realtime_fraud_detection_tpu.sim.simulator import (
+        TransactionGenerator,
+    )
+
+    def mk(seed: int = 3):
+        gen = TransactionGenerator(num_users=2000, num_merchants=500,
+                                   seed=seed)
+        s = FraudScorer(scorer_config=ScorerConfig(tokenizer="wordpiece"))
+        s.seed_profiles(gen.users.profiles(), gen.merchants.profiles())
+        return gen, s
+
+    batch = 256
+    n_col, n_ser = 17, 3
+    gen, s = mk()
+    batches = [gen.generate_batch(batch) for _ in range(n_col + 1)]
+    s.assemble(batches[0])                      # warm (jit the extractor)
+    t0 = _time.perf_counter()
+    for b in batches[1:]:
+        s.assemble(b)
+    col_s = (_time.perf_counter() - t0) / n_col
+    gen2, s2 = mk()
+    batches2 = [gen2.generate_batch(batch) for _ in range(n_ser + 1)]
+    s2.assemble_serial(batches2[0])
+    t0 = _time.perf_counter()
+    for b in batches2[1:]:
+        s2.assemble_serial(b)
+    ser_s = (_time.perf_counter() - t0) / n_ser
+    stage = {
+        "batch": batch,
+        "tokenizer": "wordpiece",
+        "columnar_us_per_txn": round(col_s / batch * 1e6, 2),
+        "serial_us_per_txn": round(ser_s / batch * 1e6, 2),
+        "speedup_vs_serial": round(ser_s / col_s, 2),
+        "token_cache": s.tokenizer.cache_stats(),
+        "entity_cache": s._join_cache.stats(),
+        "spans_ms": {k: round(v["mean_ms"], 3)
+                     for k, v in s.spans.stats().items()},
+    }
+    result["host_assembly"] = stage
+    snapshot("host_assembly")
+
+    if on_tpu or remaining() < 90:
+        return
+    # overlap drill (CPU only): same stream scored with and without the
+    # background assembler stage; the ratio is how much of the assembly
+    # wall-time the pipeline hid behind device compute. Failures here must
+    # not discard the already-captured assemble measurements (the
+    # acceptance-gate numbers above), so the drill errors into
+    # stage["overlap"] instead of propagating.
+    try:
+        _host_assembly_overlap(stage, batch, snapshot)
+    except Exception as e:  # noqa: BLE001
+        stage["overlap"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+
+
+def _host_assembly_overlap(stage: dict, batch: int, snapshot) -> None:
+    import time as _time
+
+    from realtime_fraud_detection_tpu.scoring import (
+        FraudScorer,
+        ScorerConfig,
+    )
+    from realtime_fraud_detection_tpu.sim.simulator import (
+        TransactionGenerator,
+    )
+    from realtime_fraud_detection_tpu.stream import (
+        InMemoryBroker,
+        JobConfig,
+        StreamJob,
+    )
+    from realtime_fraud_detection_tpu.stream import topics as T
+
+    def soak(overlap: bool):
+        gen3 = TransactionGenerator(num_users=2000, num_merchants=500,
+                                    seed=9)
+        broker = InMemoryBroker()
+        sc3 = FraudScorer(scorer_config=ScorerConfig(tokenizer="wordpiece"))
+        sc3.seed_profiles(gen3.users.profiles(), gen3.merchants.profiles())
+        job = StreamJob(broker, sc3, JobConfig(
+            max_batch=batch, emit_features=False,
+            overlap_assembly=overlap))
+        recs = gen3.generate_batch(4096)
+        broker.produce_batch(T.TRANSACTIONS, recs,
+                             key_fn=lambda r: str(r["user_id"]))
+        sc3.score_batch(gen3.generate_batch(batch))   # compile outside
+        t0 = _time.perf_counter()
+        job.run_until_drained(now=1000.0)
+        wall = _time.perf_counter() - t0
+        job.close()         # joins the stage thread: busy_s is final
+        busy = job._stage.busy_s if job._stage is not None else 0.0
+        return wall, busy
+
+    wall_off, _ = soak(False)
+    wall_on, busy_on = soak(True)
+    stage["overlap"] = {
+        "wall_serial_s": round(wall_off, 3),
+        "wall_overlapped_s": round(wall_on, 3),
+        "assembler_busy_s": round(busy_on, 3),
+        "speedup": round(wall_off / max(wall_on, 1e-9), 3),
+        # fraction of the background stage's busy time that vanished from
+        # the wall clock: 1.0 = assembly fully hidden behind device compute
+        "overlap_ratio": round(
+            min(1.0, max(0.0, (wall_off - wall_on) / max(busy_on, 1e-9))),
+            3),
+    }
+    snapshot("host_assembly_overlap")
 
 
 def _e2e_soak(result: dict, models, sc, bert_config, use_pallas: bool,

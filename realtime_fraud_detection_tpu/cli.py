@@ -157,7 +157,8 @@ def cmd_run_job(args: argparse.Namespace) -> int:
     job = StreamJob(broker, scorer, JobConfig(
         max_batch=args.batch, enable_analytics=args.analytics,
         enable_enrichment=args.enrichment,
-        pipeline_depth=args.pipeline_depth, qos=qos_settings))
+        pipeline_depth=args.pipeline_depth, qos=qos_settings,
+        overlap_assembly=getattr(args, "overlap_assembly", False)))
 
     metadata: Optional[MetadataStore] = None
     ckpt: Optional[CheckpointManager] = None
@@ -254,6 +255,8 @@ def cmd_serve(args: argparse.Namespace) -> int:
         config.qos.budget_ms = args.qos_budget_ms
     if getattr(args, "qos_rate", None):
         config.qos.admission_rate = args.qos_rate
+    if getattr(args, "overlap_assembly", False):
+        config.serving.overlap_assembly = True
     scorer_kwargs: Dict[str, Any] = {}
     if getattr(args, "quality_artifact", ""):
         applied = config.apply_quality_artifact(args.quality_artifact)
@@ -818,6 +821,11 @@ def build_parser() -> argparse.ArgumentParser:
                     help="per-transaction latency budget")
     sp.add_argument("--qos-rate", type=float, default=0.0,
                     help="admission token rate in txn/s (0 = unlimited)")
+    sp.add_argument("--overlap-assembly", action="store_true",
+                    help="background host-assembly stage: assemble batch "
+                         "N+1 while batch N runs on device (scoring/"
+                         "host_pipeline.py; see JobConfig.overlap_assembly "
+                         "for the staleness tradeoff)")
     sp.set_defaults(fn=cmd_run_job)
 
     sp = sub.add_parser("serve", help="run the scoring HTTP service")
@@ -840,6 +848,10 @@ def build_parser() -> argparse.ArgumentParser:
                     help="per-transaction latency budget (0 = default)")
     sp.add_argument("--qos-rate", type=float, default=0.0,
                     help="admission token rate in txn/s (0 = unlimited)")
+    sp.add_argument("--overlap-assembly", action="store_true",
+                    help="two-phase pipelined microbatcher: dispatch batch "
+                         "N+1 while batch N waits on the device "
+                         "(serving.overlap_assembly)")
     sp.set_defaults(fn=cmd_serve)
 
     sp = sub.add_parser("train", help="train tree models on synthetic data")

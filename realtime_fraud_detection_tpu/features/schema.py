@@ -369,6 +369,318 @@ def encode_transactions(
     })
 
 
+# --- columnar encode: the host-assembly hot path ---------------------------
+# Unknown-entity default rows for the columnar path, split by dtype group in
+# the exact field order the gathers below consume. Values mirror _NO_USER /
+# _NO_MERCH (FeatureExtractor.java:244-251, :288-295).
+_NO_USER_F32 = (0.8, 0.0, 0.0, 0.0, 0.5, 0.0, 0.7)
+_NO_USER_I32 = (UNKNOWN, 0, 23)
+_NO_USER_BOOL = (False, False, False, False, False)
+_NO_MERCH_F32 = (0.1, 0.0)
+_NO_MERCH_I32 = (UNKNOWN, UNKNOWN, 0, 24)
+_NO_MERCH_BOOL = (False, False, False, False, False)
+
+
+class EntityRowCache:
+    """Cross-batch cache of encode-time join rows, generation-stamped.
+
+    The per-entity profile joins are pure functions of the profile dict, so
+    their encoded rows (dtype-grouped scalar tuples) are cached across
+    microbatches and invalidated wholesale when the backing ProfileStore's
+    ``generation`` moves (any profile write). A store without a
+    ``generation`` attribute (the shared RESP tier — remote writers are
+    invisible) gets per-batch memoization only: ``sync`` clears on every
+    call. ``max_entries`` bounds each side (steady-state write-back never
+    touches profiles, so without a cap a long-running service would grow
+    one row per distinct id forever); at the cap the side is cleared
+    wholesale — misses are cheap rebuilds and the hot ids repopulate
+    within a batch. ``hits``/``misses`` feed the host-assembly Prometheus
+    series.
+    """
+
+    def __init__(self, max_entries: int = 131_072) -> None:
+        self.generation: Any = object()     # never equal to a store's int
+        self.max_entries = max(1, int(max_entries))
+        self.users: Dict[str, tuple] = {}
+        self.merchants: Dict[str, tuple] = {}
+        self.hits = 0
+        self.misses = 0
+
+    def sync(self, profile_store: Any) -> None:
+        gen = getattr(profile_store, "generation", None)
+        if gen is None or gen != self.generation:
+            self.users.clear()
+            self.merchants.clear()
+        else:
+            if len(self.users) > self.max_entries:
+                self.users.clear()
+            if len(self.merchants) > self.max_entries:
+                self.merchants.clear()
+        self.generation = gen if gen is not None else object()
+
+    def stats(self) -> Dict[str, int]:
+        return {"hits": self.hits, "misses": self.misses,
+                "entries": len(self.users) + len(self.merchants)}
+
+
+def _user_row_cols(user: Mapping[str, Any] | None) -> tuple:
+    """(f32 row, i32 row, bool row, fingerprints) for one user profile —
+    scalar-for-scalar the values _user_row produces for the serial path."""
+    if user is None:
+        return (_NO_USER_F32, _NO_USER_I32, _NO_USER_BOOL, ())
+    patterns = user.get("behavioral_patterns") or {}
+    ps = patterns.get("preferred_time_start")
+    pe = patterns.get("preferred_time_end")
+    intl = patterns.get("international_transactions")
+    kyc = user.get("kyc_status")
+    return (
+        (float(user.get("risk_score", 0.5)),
+         float(user.get("account_age_days", 0.0)),
+         float(user.get("avg_transaction_amount", 0.0)),
+         float(user.get("transaction_frequency", 0.0)),
+         float(patterns.get("weekend_activity", 0.5)),
+         float(intl if intl is not None else 0.0),
+         float(patterns.get("online_preference", 0.7))),
+        (_dcode(_KYC_CODE, kyc),
+         int(ps if ps is not None else 0),
+         int(pe if pe is not None else 23)),
+        (True,
+         str(kyc or "") == "verified",
+         ps is not None and pe is not None,
+         intl is not None,
+         bool(user.get("device_fingerprints"))),
+        user.get("device_fingerprints") or (),
+    )
+
+
+def _merch_row_cols(merch: Mapping[str, Any] | None) -> tuple:
+    """(f32 row, i32 row, bool row) for one merchant profile — the columnar
+    twin of _merch_row."""
+    if merch is None:
+        return (_NO_MERCH_F32, _NO_MERCH_I32, _NO_MERCH_BOOL)
+    cat, risk = merch.get("category"), merch.get("risk_level")
+    hours = merch.get("operating_hours") or {}
+    return (
+        (float(merch.get("fraud_rate", 0.05)),
+         float(merch.get("avg_transaction_amount", 0.0))),
+        (_dcode(_RL_CODE, risk),
+         _dcode(_MC_CODE, cat),
+         int(hours.get("start_hour", 0)),
+         int(hours.get("end_hour", 24))),
+        (True,
+         bool(merch.get("is_blacklisted", False)),
+         (str(cat) in HIGH_RISK_CATEGORIES or str(risk) == "high"),
+         "start_hour" in hours and "end_hour" in hours,
+         is_suspicious_merchant_name(merch.get("name"))),
+    )
+
+
+def encode_transactions_columnar(
+    records: Sequence[Mapping[str, Any]],
+    user_profiles: Mapping[str, Mapping[str, Any]] | None = None,
+    merchant_profiles: Mapping[str, Mapping[str, Any]] | None = None,
+    velocities: Mapping[str, Mapping[str, Mapping[str, float]]] | None = None,
+    cache: EntityRowCache | None = None,
+) -> TransactionBatch:
+    """Columnar twin of ``encode_transactions``: bit-identical output.
+
+    The per-record Python loop shrinks to the ~20 transaction-core fields;
+    every profile/velocity join becomes one dense gather — unique entities
+    are resolved to dtype-grouped row tables (cached across batches via
+    ``cache``; see EntityRowCache) and fancy-indexed back out to records.
+    The equivalence tests (tests/test_host_pipeline.py) pin columnar ==
+    serial on randomized records, including after profile rewrites.
+    """
+    if not records:
+        return encode_transactions(records, user_profiles,
+                                   merchant_profiles, velocities)
+    user_profiles = user_profiles or {}
+    merchant_profiles = merchant_profiles or {}
+    velocities = velocities or {}
+    if cache is None:
+        cache = EntityRowCache()
+    n = len(records)
+
+    cols: Dict[str, Any] = {}
+    # ---- transaction-core fields: the one remaining per-record loop
+    amount: list = []
+    hour_of_day: list = []
+    day_of_week: list = []
+    day_of_month: list = []
+    is_weekend: list = []
+    has_geo: list = []
+    lat: list = []
+    lon: list = []
+    has_mgeo: list = []
+    mlat: list = []
+    mlon: list = []
+    pm_code: list = []
+    high_risk_pm: list = []
+    tt_code: list = []
+    ct_code: list = []
+    sus_ua: list = []
+    private_ip: list = []
+    ip_risk: list = []
+    prior_score: list = []
+    has_fp: list = []
+    fps: list = []                       # device fingerprint (or None)
+    uid_of: list = []
+    mid_of: list = []
+    pm_memo: Dict[Any, tuple] = {}
+    for rec in records:
+        get = rec.get
+        geo = get("geolocation") or {}
+        mgeo = get("merchant_location") or {}
+        amount.append(float(get("amount", 0.0)))
+        hour_of_day.append(int(get("hour_of_day", 12)))
+        day_of_week.append(int(get("day_of_week", 1)))
+        day_of_month.append(int(get("day_of_month", 1)))
+        is_weekend.append(bool(get("is_weekend", False)))
+        has_geo.append(bool(geo) and geo.get("lat") is not None)
+        lat.append(float(geo.get("lat", 0.0) or 0.0))
+        lon.append(float(geo.get("lon", 0.0) or 0.0))
+        has_mgeo.append(bool(mgeo) and mgeo.get("lat") is not None)
+        mlat.append(float(mgeo.get("lat", 0.0) or 0.0))
+        mlon.append(float(mgeo.get("lon", 0.0) or 0.0))
+        pm = get("payment_method")
+        pm_row = pm_memo.get(pm)
+        if pm_row is None:
+            pm_memo[pm] = pm_row = (
+                _dcode(_PM_CODE, pm), is_high_risk_payment(pm))
+        pm_code.append(pm_row[0])
+        high_risk_pm.append(pm_row[1])
+        tt_code.append(_dcode(_TT_CODE, get("transaction_type")))
+        ct_code.append(_dcode(_CT_CODE, get("card_type")))
+        sus_ua.append(is_suspicious_user_agent(get("user_agent")))
+        private = is_private_ip(get("ip_address"))
+        private_ip.append(private)
+        ip_risk.append(0.1 if private else 0.3)
+        prior_score.append(float(get("fraud_score", 0.0)))
+        fp = get("device_fingerprint")
+        has_fp.append(fp is not None)
+        fps.append(fp)
+        uid_of.append(str(get("user_id", "")))
+        mid_of.append(str(get("merchant_id", "")))
+
+    cols["amount"] = np.array(amount, np.float32)
+    cols["hour_of_day"] = np.array(hour_of_day, np.int32)
+    cols["day_of_week"] = np.array(day_of_week, np.int32)
+    cols["day_of_month"] = np.array(day_of_month, np.int32)
+    cols["is_weekend"] = np.array(is_weekend, np.bool_)
+    cols["has_geo"] = np.array(has_geo, np.bool_)
+    cols["lat"] = np.array(lat, np.float32)
+    cols["lon"] = np.array(lon, np.float32)
+    cols["has_merchant_geo"] = np.array(has_mgeo, np.bool_)
+    cols["merchant_lat"] = np.array(mlat, np.float32)
+    cols["merchant_lon"] = np.array(mlon, np.float32)
+    cols["payment_method_code"] = np.array(pm_code, np.int32)
+    cols["high_risk_payment"] = np.array(high_risk_pm, np.bool_)
+    cols["transaction_type_code"] = np.array(tt_code, np.int32)
+    cols["card_type_code"] = np.array(ct_code, np.int32)
+    cols["suspicious_user_agent"] = np.array(sus_ua, np.bool_)
+    cols["private_ip"] = np.array(private_ip, np.bool_)
+    cols["ip_risk"] = np.array(ip_risk, np.float32)
+    cols["prior_fraud_score"] = np.array(prior_score, np.float32)
+    cols["has_txn_fingerprint"] = np.array(has_fp, np.bool_)
+
+    # ---- user join: unique -> cached rows -> stacked tables -> gather
+    u_index: Dict[str, int] = {}
+    u_rows: list = []
+    u_inv = np.empty((n,), np.int64)
+    for i, uid in enumerate(uid_of):
+        j = u_index.get(uid)
+        if j is None:
+            j = len(u_rows)
+            u_index[uid] = j
+            row = cache.users.get(uid)
+            if row is None:
+                cache.misses += 1
+                row = _user_row_cols(user_profiles.get(uid))
+                cache.users[uid] = row
+            else:
+                cache.hits += 1
+            u_rows.append(row)
+        u_inv[i] = j
+    uf = np.array([r[0] for r in u_rows], np.float32)[u_inv]
+    ui = np.array([r[1] for r in u_rows], np.int32)[u_inv]
+    ub = np.array([r[2] for r in u_rows], np.bool_)[u_inv]
+    cols["user_risk_score"] = uf[:, 0]
+    cols["account_age_days"] = uf[:, 1]
+    cols["user_avg_amount"] = uf[:, 2]
+    cols["user_txn_frequency"] = uf[:, 3]
+    cols["weekend_activity"] = uf[:, 4]
+    cols["intl_ratio"] = uf[:, 5]
+    cols["online_preference"] = uf[:, 6]
+    cols["kyc_code"] = ui[:, 0]
+    cols["preferred_start"] = ui[:, 1]
+    cols["preferred_end"] = ui[:, 2]
+    cols["has_user"] = ub[:, 0]
+    cols["user_verified"] = ub[:, 1]
+    cols["has_preferred_hours"] = ub[:, 2]
+    cols["has_intl_ratio"] = ub[:, 3]
+    cols["has_device_list"] = ub[:, 4]
+    cols["known_device"] = np.array(
+        [fp is not None and fp in u_rows[u_inv[i]][3]
+         for i, fp in enumerate(fps)], np.bool_)
+
+    # ---- merchant join
+    m_index: Dict[str, int] = {}
+    m_rows: list = []
+    m_inv = np.empty((n,), np.int64)
+    for i, mid in enumerate(mid_of):
+        j = m_index.get(mid)
+        if j is None:
+            j = len(m_rows)
+            m_index[mid] = j
+            row = cache.merchants.get(mid)
+            if row is None:
+                cache.misses += 1
+                row = _merch_row_cols(merchant_profiles.get(mid))
+                cache.merchants[mid] = row
+            else:
+                cache.hits += 1
+            m_rows.append(row)
+        m_inv[i] = j
+    mf = np.array([r[0] for r in m_rows], np.float32)[m_inv]
+    mi = np.array([r[1] for r in m_rows], np.int32)[m_inv]
+    mb = np.array([r[2] for r in m_rows], np.bool_)[m_inv]
+    cols["merchant_fraud_rate"] = mf[:, 0]
+    cols["merchant_avg_amount"] = mf[:, 1]
+    cols["merchant_risk_code"] = mi[:, 0]
+    cols["merchant_category_code"] = mi[:, 1]
+    cols["merchant_op_start"] = mi[:, 2]
+    cols["merchant_op_end"] = mi[:, 3]
+    cols["has_merchant"] = mb[:, 0]
+    cols["merchant_blacklisted"] = mb[:, 1]
+    cols["merchant_high_risk_category"] = mb[:, 2]
+    cols["has_op_hours"] = mb[:, 3]
+    cols["suspicious_merchant_name"] = mb[:, 4]
+
+    # ---- velocity join: one row per unique user this batch (windows move
+    # every write-back, so these rows are per-batch, never cross-batch)
+    v_rows = np.empty((len(u_rows), 6), np.float32)
+    _EMPTY_VEL: Dict[str, Mapping[str, float]] = {}
+    _EMPTY_W: Dict[str, float] = {}
+    for uid, j in u_index.items():
+        vel = velocities.get(uid) or _EMPTY_VEL
+        w5 = vel.get("5min") or _EMPTY_W
+        w1 = vel.get("1hour") or _EMPTY_W
+        w24 = vel.get("24hour") or _EMPTY_W
+        v_rows[j] = (float(w5.get("count", 0.0)), float(w5.get("amount", 0.0)),
+                     float(w1.get("count", 0.0)), float(w1.get("amount", 0.0)),
+                     float(w24.get("count", 0.0)),
+                     float(w24.get("amount", 0.0)))
+    vg = v_rows[u_inv]
+    cols["velocity_5min_count"] = vg[:, 0]
+    cols["velocity_5min_amount"] = vg[:, 1]
+    cols["velocity_1hour_count"] = vg[:, 2]
+    cols["velocity_1hour_amount"] = vg[:, 3]
+    cols["velocity_24hour_count"] = vg[:, 4]
+    cols["velocity_24hour_amount"] = vg[:, 5]
+
+    return TransactionBatch(**cols)
+
+
 _BOOL_FIELDS = {
     "is_weekend", "has_geo", "has_merchant_geo", "high_risk_payment",
     "suspicious_user_agent", "private_ip", "has_txn_fingerprint", "has_user",

@@ -19,7 +19,8 @@ from __future__ import annotations
 
 import re
 import zlib
-from typing import List, Sequence, Tuple
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -30,18 +31,66 @@ _WORD_ID_START = 1000
 _HASH_ID_START = 2000
 
 
+class TokenLruCache:
+    """Bounded LRU of text -> token-id rows for the assembly hot path.
+
+    Merchant/description strings are heavily templated, so whole-text rows
+    repeat constantly across a stream; caching the encoded row turns most
+    per-record tokenization into one dict hit. True LRU (not the old
+    clear-when-full wipe): under eviction pressure the hot merchant texts
+    stay resident while one-off strings age out. ``hits``/``misses`` are
+    cumulative and feed the host-assembly Prometheus series
+    (obs/metrics.MetricsCollector.sync_host_stats).
+    """
+
+    __slots__ = ("max_entries", "hits", "misses", "_data")
+
+    def __init__(self, max_entries: int = 65_536):
+        self.max_entries = max(1, int(max_entries))
+        self.hits = 0
+        self.misses = 0
+        self._data: "OrderedDict[str, Tuple[int, ...]]" = OrderedDict()
+
+    def get(self, key: str) -> Optional[Tuple[int, ...]]:
+        row = self._data.get(key)
+        if row is None:
+            self.misses += 1
+            return None
+        self._data.move_to_end(key)
+        self.hits += 1
+        return row
+
+    def put(self, key: str, row: Sequence[int]) -> None:
+        data = self._data
+        data[key] = tuple(row)
+        data.move_to_end(key)
+        while len(data) > self.max_entries:
+            data.popitem(last=False)
+
+    def __len__(self) -> int:
+        return len(self._data)
+
+    def clear(self) -> None:
+        self._data.clear()
+
+    def stats(self) -> Dict[str, int]:
+        return {"hits": self.hits, "misses": self.misses,
+                "entries": len(self._data), "max_entries": self.max_entries}
+
+
 class FraudTokenizer:
     """Whitespace word tokenizer with fixed domain vocab + hashed OOV."""
 
-    def __init__(self, vocab_size: int = 30522, max_length: int = 128):
+    def __init__(self, vocab_size: int = 30522, max_length: int = 128,
+                 cache_entries: int = 65_536):
         self.vocab_size = vocab_size
         self.max_length = max_length
         self.vocab = {w: _WORD_ID_START + i for i, w in enumerate(vocabulary_words())}
         assert _WORD_ID_START + len(self.vocab) <= _HASH_ID_START
-        # memo caches for the scoring hot path: merchant/description strings
-        # are heavily templated, so whole-text rows repeat constantly, and
-        # OOV words repeat across texts (bounded: cleared when full)
-        self._text_cache: dict[str, List[int]] = {}
+        # memo caches for the scoring hot path: whole-text rows in a true
+        # LRU (see TokenLruCache), and OOV words repeating across texts
+        # (bounded: cleared when full)
+        self.text_cache = TokenLruCache(cache_entries)
         self._oov_cache: dict[str, int] = {}
 
     @staticmethod
@@ -67,16 +116,14 @@ class FraudTokenizer:
         return wid
 
     def encode(self, text: str) -> List[int]:
-        cached = self._text_cache.get(text)
+        cached = self.text_cache.get(text)
         if cached is not None:
             return list(cached)     # copy: callers may mutate their row
         words = self.preprocess(text).split()
         ids = [CLS_ID] + [self._word_id(w) for w in words] + [SEP_ID]
         ids = ids[: self.max_length]
-        if len(self._text_cache) >= 50_000:
-            self._text_cache.clear()
-        self._text_cache[text] = ids
-        return list(ids)
+        self.text_cache.put(text, ids)
+        return ids
 
     def encode_batch(self, texts: Sequence[str]) -> Tuple[np.ndarray, np.ndarray]:
         """Batch to fixed (B, max_length) ids + attention mask."""
@@ -88,3 +135,6 @@ class FraudTokenizer:
             ids[i, : len(row)] = row
             mask[i, : len(row)] = True
         return ids, mask
+
+    def cache_stats(self) -> dict:
+        return self.text_cache.stats()

@@ -123,7 +123,8 @@ class WordPieceTokenizer:
 
     def __init__(self, vocab: Sequence[str] | None = None,
                  vocab_path: Path | str | None = None,
-                 max_length: int = 128, max_word_chars: int = 64):
+                 max_length: int = 128, max_word_chars: int = 64,
+                 cache_entries: int = 65_536):
         if vocab is None:
             path = Path(vocab_path) if vocab_path else DEFAULT_VOCAB_PATH
             vocab = [ln.rstrip("\n") for ln in
@@ -135,6 +136,18 @@ class WordPieceTokenizer:
         self.vocab_size = _PIECE_ID_START + len(self.pieces)
         self.max_length = max_length
         self.max_word_chars = max_word_chars
+        # host-assembly hot path: combined merchant/description texts repeat
+        # heavily across a stream, and the greedy longest-match encode is the
+        # single most expensive per-record step of assembly — the whole-text
+        # LRU turns repeats into one dict hit; the word memo speeds the
+        # misses (words repeat across distinct texts). The vocab is fixed
+        # after construction, so cached rows can never go stale.
+        from realtime_fraud_detection_tpu.models.tokenizer import (
+            TokenLruCache,
+        )
+
+        self.text_cache = TokenLruCache(cache_entries)
+        self._word_cache: Dict[str, List[int]] = {}
 
     # ------------------------------------------------------------ encoding
     def _encode_word(self, word: str) -> List[int]:
@@ -162,13 +175,29 @@ class WordPieceTokenizer:
             start = end
         return ids
 
+    def _encode_word_cached(self, word: str) -> List[int]:
+        ids = self._word_cache.get(word)
+        if ids is None:
+            if len(self._word_cache) >= 200_000:
+                self._word_cache.clear()
+            self._word_cache[word] = ids = self._encode_word(word)
+        return ids
+
     def encode(self, text: str) -> List[int]:
+        cached = self.text_cache.get(text)
+        if cached is not None:
+            return list(cached)     # copy: callers may mutate their row
         words = FraudTokenizer.preprocess(text).split()
         ids = [CLS_ID]
         for w in words:
-            ids.extend(self._encode_word(w))
+            ids.extend(self._encode_word_cached(w))
         ids.append(SEP_ID)
-        return ids[: self.max_length]
+        ids = ids[: self.max_length]
+        self.text_cache.put(text, ids)
+        return ids
+
+    def cache_stats(self) -> dict:
+        return self.text_cache.stats()
 
     def encode_batch(self, texts: Sequence[str]) -> Tuple[np.ndarray, np.ndarray]:
         b = len(texts)

@@ -311,6 +311,48 @@ class MetricsCollector:
             "(negative = deadline blown)",
             buckets=(-0.1, -0.02, -0.005, 0.0, 0.001, 0.0025, 0.005,
                      0.01, 0.015, 0.02, 0.05, 0.1))
+        # host-assembly plane (columnar assemble + token/entity caches +
+        # overlapped assembler stage): cumulative cache hit/miss counts and
+        # per-stage wall-clock stats, mirrored from FraudScorer.host_stats()
+        # by sync_host_stats — same registry, same Prometheus exposition
+        self.host_cache_hits = r.counter(
+            "host_assembly_cache_hits_total",
+            "Cumulative host-assembly cache hits (token LRU, entity join "
+            "rows)", ("cache",))
+        self.host_cache_misses = r.counter(
+            "host_assembly_cache_misses_total",
+            "Cumulative host-assembly cache misses", ("cache",))
+        self.host_stage_ms = r.gauge(
+            "host_assembly_stage_ms",
+            "Host-side per-stage timing (assemble/pack/dispatch/"
+            "device_wait)", ("stage", "stat"))
+        # last-mirrored cache totals, so sync_host_stats can inc the
+        # counters by deltas (keeps the _total series honest counters —
+        # rate()/increase() and promtool lint stay valid)
+        self._host_cache_seen: Dict[Tuple[str, str], float] = {}
+
+    def sync_host_stats(self, host_stats: Mapping[str, Any]) -> None:
+        """Mirror ``FraudScorer.host_stats()`` into the Prometheus series.
+
+        Called at exposition time so the scorer's hot path never touches
+        the metrics lock per record. Cache totals mirror as counter
+        DELTAS against the last-seen values (a scorer swap that resets its
+        counters contributes 0 until it catches up — the standard
+        counter-mirror compromise, never a negative increment)."""
+        for name, st in (host_stats.get("caches") or {}).items():
+            for kind, counter in (("hits", self.host_cache_hits),
+                                  ("misses", self.host_cache_misses)):
+                total = float(st.get(kind, 0))
+                key = (name, kind)
+                delta = total - self._host_cache_seen.get(key, 0.0)
+                if delta > 0:
+                    counter.inc(delta, cache=name)
+                self._host_cache_seen[key] = total
+        for stage, st in (host_stats.get("stages") or {}).items():
+            for stat in ("mean_ms", "p50_ms", "p99_ms"):
+                self.host_stage_ms.set(float(st.get(stat, 0.0)),
+                                       stage=stage,
+                                       stat=stat.replace("_ms", ""))
 
     # ------------------------------------------------------------- recording
     def record_prediction(self, decision: str, fraud_score: float,

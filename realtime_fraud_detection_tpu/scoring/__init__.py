@@ -11,4 +11,8 @@ from realtime_fraud_detection_tpu.scoring.pipeline import (  # noqa: F401
     score_fused,
     score_fused_packed,
 )
+from realtime_fraud_detection_tpu.scoring.host_pipeline import (  # noqa: F401
+    AssembledHandle,
+    AssemblerStage,
+)
 from realtime_fraud_detection_tpu.scoring.scorer import FraudScorer  # noqa: F401

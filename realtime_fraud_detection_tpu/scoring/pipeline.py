@@ -260,6 +260,10 @@ class ScorerConfig:
     # algorithm (models/wordpiece.py — the reference's tokenizer class,
     # bert_text_analyzer.py:47-66, minus the hub download)
     tokenizer: str = "word"
+    # whole-text token LRU size (models/tokenizer.TokenLruCache): merchant
+    # texts repeat heavily, so the default keeps every live merchant string
+    # resident; shrink for memory-tight hosts
+    token_cache_entries: int = 65_536
     use_pallas: bool = False   # Pallas flash attention (TPU only)
     # start the result's device->host copy at dispatch time so the transfer
     # overlaps the next batch's host work (scorer.dispatch). Tunable because

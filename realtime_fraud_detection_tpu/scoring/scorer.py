@@ -25,7 +25,7 @@ import numpy as np
 
 from realtime_fraud_detection_tpu.core.batching import (
     BATCH_BUCKETS,
-    pad_to_bucket,
+    bucket_for,
 )
 from realtime_fraud_detection_tpu.core.mesh import (
     build_mesh,
@@ -95,30 +95,84 @@ class PendingScore:
 
 
 class _EntityIndex:
-    """Stable string-id -> dense int index with on-the-fly node features."""
+    """Stable string-id -> dense int index with on-the-fly node features.
+
+    Rows live in one preallocated, doubling (capacity, node_dim) table
+    written in place — ``table()`` is a zero-copy slice, never a restack
+    (the old stacked-row cache re-stacked every batch that saw a new
+    entity, which on a fresh stream is every batch).
+    """
 
     def __init__(self, node_dim: int):
         self.node_dim = node_dim
         self._idx: Dict[str, int] = {}
-        self._rows: List[np.ndarray] = []
         self._profiled: set[str] = set()
-        self._table: Optional[np.ndarray] = None  # stacked-row cache
+        self._tbl = np.zeros((256, node_dim), np.float32)
+        self._n = 0
+
+    def __setstate__(self, state) -> None:
+        """Checkpoint migration: pre-host-plane snapshots pickled the
+        stacked-row form (``_rows``/``_table``); rebuild the in-place
+        table from it."""
+        if "_rows" not in state:
+            self.__dict__.update(state)
+            return
+        self.node_dim = state["node_dim"]
+        self._idx = state["_idx"]
+        self._profiled = state["_profiled"]
+        rows = state["_rows"]
+        self._n = len(rows)
+        cap = 256
+        while cap < max(self._n, 1):
+            cap *= 2
+        self._tbl = np.zeros((cap, self.node_dim), np.float32)
+        if rows:
+            self._tbl[: self._n] = np.stack(rows, axis=0)
+
+    def _grow(self, need: int) -> None:
+        cap = self._tbl.shape[0]
+        if need <= cap:
+            return
+        while cap < need:
+            cap *= 2
+        tbl = np.zeros((cap, self.node_dim), np.float32)
+        tbl[: self._tbl.shape[0]] = self._tbl
+        self._tbl = tbl
 
     def lookup(self, entity_id: str, profile: Optional[Mapping[str, Any]],
                is_merchant: bool) -> int:
         i = self._idx.get(entity_id)
         if i is None:
-            i = len(self._rows)
+            i = self._n
             self._idx[entity_id] = i
-            self._rows.append(self._featurize(profile, is_merchant))
-            self._table = None
+            self._grow(i + 1)
+            self._tbl[i] = self._featurize(profile, is_merchant)
+            self._n += 1
         elif profile is not None and entity_id not in self._profiled:
             # a profile arrived after first sight — refresh the stale zero row
-            self._rows[i] = self._featurize(profile, is_merchant)
-            self._table = None
+            self._tbl[i] = self._featurize(profile, is_merchant)
         if profile is not None:
             self._profiled.add(entity_id)
         return i
+
+    def lookup_batch(self, entity_ids: Sequence[str],
+                     profiles: Mapping[str, Mapping[str, Any]],
+                     is_merchant: bool) -> np.ndarray:
+        """Batched lookup: one dense index vector for a whole microbatch.
+        Featurization runs only for ids never seen (or first seen without a
+        profile that has one now) — the steady-state batch is pure dict
+        hits."""
+        out = np.empty((len(entity_ids),), np.int64)
+        idx_get = self._idx.get
+        prof_get = profiles.get
+        profiled = self._profiled
+        for k, eid in enumerate(entity_ids):
+            i = idx_get(eid)
+            if i is None or (eid not in profiled
+                             and prof_get(eid) is not None):
+                i = self.lookup(eid, prof_get(eid), is_merchant)
+            out[k] = i
+        return out
 
     def _featurize(self, p: Optional[Mapping[str, Any]], is_merchant: bool) -> np.ndarray:
         """Node features mirroring models.gnn.build_node_features slots."""
@@ -155,11 +209,46 @@ class _EntityIndex:
         return row
 
     def table(self) -> np.ndarray:
-        if self._table is None:
-            if not self._rows:
-                return np.zeros((1, self.node_dim), np.float32)
-            self._table = np.stack(self._rows, axis=0)
-        return self._table
+        return self._tbl[: self._n] if self._n else self._tbl[:1]
+
+
+class _StagingBuffers:
+    """Preallocated, reused pad staging per bucket shape.
+
+    ``pad`` writes a microbatch's leaves into the bucket-sized buffers
+    (write-into, not rebuild) with pad rows replicating row 0, exactly like
+    core/batching.pad_to_bucket — minus the 65 fresh allocations per batch.
+    Safe to reuse because core/packing.pack_tree copies every leaf into the
+    transfer blobs before ``dispatch`` returns; nothing downstream holds a
+    reference to the staging arrays. NOT safe for concurrent dispatches —
+    the same contract as the scorer's state stores (single assembly thread).
+    """
+
+    def __init__(self) -> None:
+        self._bufs: Dict[int, List[np.ndarray]] = {}
+        self._masks: Dict[int, np.ndarray] = {}
+
+    def pad(self, tree: Any, n: int, size: int) -> tuple:
+        import jax
+
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        bufs = self._bufs.get(size)
+        shapes = [((size,) + np.shape(lf)[1:], np.asarray(lf).dtype)
+                  for lf in leaves]
+        if bufs is None or [(b.shape, b.dtype) for b in bufs] != shapes:
+            bufs = [np.empty(shape, dtype) for shape, dtype in shapes]
+            self._bufs[size] = bufs
+        for buf, leaf in zip(bufs, leaves):
+            arr = np.asarray(leaf)
+            buf[:n] = arr
+            if n < size:
+                buf[n:] = arr[:1]          # replicate row 0 (pad_to_bucket)
+        mask = self._masks.get(size)
+        if mask is None:
+            self._masks[size] = mask = np.zeros((size,), bool)
+        mask[:n] = True
+        mask[n:] = False
+        return jax.tree_util.tree_unflatten(treedef, bufs), mask
 
 
 class FraudScorer:
@@ -242,11 +331,14 @@ class FraudScorer:
                 WordPieceTokenizer,
             )
 
-            self.tokenizer = WordPieceTokenizer(max_length=self.sc.text_len)
+            self.tokenizer = WordPieceTokenizer(
+                max_length=self.sc.text_len,
+                cache_entries=self.sc.token_cache_entries)
         elif self.sc.tokenizer == "word":
             self.tokenizer = FraudTokenizer(
                 vocab_size=bert_config.vocab_size,
                 max_length=self.sc.text_len,
+                cache_entries=self.sc.token_cache_entries,
             )
         else:
             # a typo'd tokenizer name must not silently feed a text model
@@ -254,8 +346,30 @@ class FraudScorer:
             raise ValueError(
                 f"ScorerConfig.tokenizer must be 'word' or 'wordpiece', "
                 f"got {self.sc.tokenizer!r}")
+        if self.tokenizer.vocab_size > bert_config.vocab_size:
+            # JAX gathers clamp out-of-bounds indices SILENTLY: a token id
+            # beyond the embedding table would score through row
+            # vocab_size-1 with no error anywhere (ADVICE r5) — refuse the
+            # pairing at construction instead
+            raise ValueError(
+                f"tokenizer vocab_size {self.tokenizer.vocab_size} exceeds "
+                f"bert_config.vocab_size {bert_config.vocab_size}: "
+                f"out-of-range ids would be silently clamped by the "
+                f"embedding gather")
         self._users = _EntityIndex(self.sc.node_dim)
         self._merchants = _EntityIndex(self.sc.node_dim)
+        # host-assembly plane: cross-batch entity join-row cache
+        # (generation-stamped against the profile store), reusable pad
+        # staging per bucket, and per-stage wall-clock spans
+        # (assemble/pack/dispatch/device_wait) for the obs plane + bench
+        from realtime_fraud_detection_tpu.features.schema import (
+            EntityRowCache,
+        )
+        from realtime_fraud_detection_tpu.obs.profiling import SpanTimer
+
+        self._join_cache = EntityRowCache()
+        self._staging = _StagingBuffers()
+        self.spans = SpanTimer()
         self.last_features = np.zeros((0, self.sc.feature_dim), np.float32)
         self.stats: Dict[str, float] = {"scored": 0, "batches": 0, "total_time_s": 0.0}
         # top-10 global feature importances (reference explanation field,
@@ -340,7 +454,17 @@ class FraudScorer:
     # ---------------------------------------------------------------- assembly
     def assemble(self, records: Sequence[Mapping[str, Any]],
                  now: Optional[float] = None) -> ScoreBatch:
-        """Join state + encode one dense ScoreBatch (host side of the seam)."""
+        """Join state + encode one dense ScoreBatch (host side of the seam).
+
+        Columnar: profile/velocity joins gather through the generation-
+        stamped entity row cache (features/schema.EntityRowCache), entity
+        indices resolve in one batched lookup, history gathers from the
+        slot-table ring store, and repeated merchant texts hit the token
+        LRU — the per-record Python work shrinks to the transaction-core
+        fields. Bit-identical to ``assemble_serial`` (the record-at-a-time
+        reference path) by construction and by test.
+        """
+        t0 = time.perf_counter()
         user_ids = [str(r.get("user_id", "")) for r in records]
         merchant_ids = [str(r.get("merchant_id", "")) for r in records]
         uprofs = {u: p for u in user_ids
@@ -349,7 +473,14 @@ class FraudScorer:
                   if (p := self.profiles.get_merchant(m)) is not None}
         velocities = {u: self.velocity.get_all(u, now) for u in set(user_ids)}
 
-        txn = encode_transactions(records, uprofs, mprofs, velocities)
+        from realtime_fraud_detection_tpu.features.schema import (
+            encode_transactions_columnar,
+        )
+
+        self._join_cache.sync(self.profiles)
+        txn = encode_transactions_columnar(records, uprofs, mprofs,
+                                           velocities,
+                                           cache=self._join_cache)
 
         # feature history for the LSTM branch: append-then-gather semantics.
         # Extraction runs on the HOST backend: the rows are needed host-side
@@ -363,8 +494,8 @@ class FraudScorer:
         history, history_len = self.history.append_and_gather(user_ids, feats)
 
         # entity graph for the GNN branch
-        u_idx = [self._users.lookup(u, uprofs.get(u), False) for u in user_ids]
-        m_idx = [self._merchants.lookup(m, mprofs.get(m), True) for m in merchant_ids]
+        u_idx = self._users.lookup_batch(user_ids, uprofs, False)
+        m_idx = self._merchants.lookup_batch(merchant_ids, mprofs, True)
         un_idx, un_mask = self.graph.user_neighbors(u_idx)
         mn_idx, mn_mask = self.graph.merchant_neighbors(m_idx)
         utable, mtable = self._users.table(), self._merchants.table()
@@ -374,19 +505,10 @@ class FraudScorer:
         mn_feat = utable[np.where(mn_mask, mn_idx, 0)]
         self.graph.add_edges(u_idx, m_idx)
 
-        # text branch tokens
-        texts = []
-        for r, m in zip(records, merchant_ids):
-            mp = mprofs.get(m) or {}
-            texts.append(combined_text({
-                "merchant_name": mp.get("name") or str(r.get("merchant_name", "")),
-                "description": str(r.get("description", "") or ""),
-                "category": str(mp.get("category", "") or ""),
-                "location": str(r.get("location", "") or ""),
-            }))
-        token_ids, token_mask = self.tokenizer.encode_batch(texts)
+        token_ids, token_mask = self.tokenizer.encode_batch(
+            self._texts_for(records, merchant_ids, mprofs))
 
-        return ScoreBatch(
+        batch = ScoreBatch(
             txn=txn,
             features=feats,
             history=history,
@@ -401,6 +523,115 @@ class FraudScorer:
             token_mask=token_mask.astype(bool),
             valid=np.ones((len(records),), bool),
         )
+        self.spans.record("assemble", time.perf_counter() - t0)
+        return batch
+
+    def _texts_for(self, records, merchant_ids, mprofs) -> List[str]:
+        """Combined text per record for the text branch (models/text.py)."""
+        texts = []
+        for r, m in zip(records, merchant_ids):
+            mp = mprofs.get(m) or {}
+            texts.append(combined_text({
+                "merchant_name": mp.get("name") or str(r.get("merchant_name", "")),
+                "description": str(r.get("description", "") or ""),
+                "category": str(mp.get("category", "") or ""),
+                "location": str(r.get("location", "") or ""),
+            }))
+        return texts
+
+    def assemble_serial(self, records: Sequence[Mapping[str, Any]],
+                        now: Optional[float] = None) -> ScoreBatch:
+        """Record-at-a-time reference assembly: the pre-columnar baseline.
+
+        Every record runs the full join/encode/tokenize path alone (one
+        1-row encode, one 1-row feature extraction, one history append, one
+        tokenize) and the rows are stacked at the end — exactly the cost
+        profile of the reference's per-request serving loop
+        (main.py:235-248). Kept as the equivalence oracle for the columnar
+        path and as the baseline the bench's host-assembly stage measures
+        against. The one batch-level carve-out: graph neighbor sampling for
+        ALL records precedes this batch's edge inserts, matching the batch
+        path's sample-then-insert order (per-record interleaving would make
+        row i+1 see row i's edge — a different, order-dependent batch).
+        """
+        from realtime_fraud_detection_tpu.features.extract import (
+            extract_features_host,
+        )
+
+        n = len(records)
+        user_ids = [str(r.get("user_id", "")) for r in records]
+        merchant_ids = [str(r.get("merchant_id", "")) for r in records]
+        txns: List[Any] = []
+        feat_rows: List[np.ndarray] = []
+        hist_rows: List[np.ndarray] = []
+        hist_lens: List[np.ndarray] = []
+        tok_rows: List[np.ndarray] = []
+        tok_masks: List[np.ndarray] = []
+        u_idx = np.empty((n,), np.int64)
+        m_idx = np.empty((n,), np.int64)
+        mprofs: Dict[str, Any] = {}
+        for i, (r, uid, mid) in enumerate(zip(records, user_ids,
+                                              merchant_ids)):
+            up = self.profiles.get_user(uid)
+            mp = self.profiles.get_merchant(mid)
+            if mp is not None:
+                mprofs[mid] = mp
+            txn = encode_transactions(
+                [r],
+                {uid: up} if up is not None else {},
+                {mid: mp} if mp is not None else {},
+                {uid: self.velocity.get_all(uid, now)})
+            feats = extract_features_host(txn)
+            hist, hlen = self.history.append_and_gather([uid], feats)
+            u_idx[i] = self._users.lookup(uid, up, False)
+            m_idx[i] = self._merchants.lookup(mid, mp, True)
+            ids, mask = self.tokenizer.encode_batch(
+                self._texts_for([r], [mid], mprofs))
+            txns.append(txn)
+            feat_rows.append(feats)
+            hist_rows.append(hist)
+            hist_lens.append(hlen)
+            tok_rows.append(ids)
+            tok_masks.append(mask)
+
+        un_idx, un_mask = self.graph.user_neighbors(u_idx)
+        mn_idx, mn_mask = self.graph.merchant_neighbors(m_idx)
+        utable, mtable = self._users.table(), self._merchants.table()
+        un_feat = mtable[np.where(un_mask, un_idx, 0)]
+        mn_feat = utable[np.where(mn_mask, mn_idx, 0)]
+        self.graph.add_edges(u_idx, m_idx)
+
+        txn_all = jax.tree_util.tree_map(
+            lambda *leaves: np.concatenate([np.asarray(lf) for lf in leaves],
+                                           axis=0), *txns)
+        feats = np.concatenate(feat_rows, axis=0)
+        self.last_features = feats
+        return ScoreBatch(
+            txn=txn_all,
+            features=feats,
+            history=np.concatenate(hist_rows, axis=0),
+            history_len=np.concatenate(hist_lens, axis=0),
+            user_feat=utable[u_idx],
+            merchant_feat=mtable[m_idx],
+            user_neigh_feat=un_feat,
+            user_neigh_mask=un_mask,
+            merch_neigh_feat=mn_feat,
+            merch_neigh_mask=mn_mask,
+            token_ids=np.concatenate(tok_rows, axis=0).astype(np.int32),
+            token_mask=np.concatenate(tok_masks, axis=0).astype(bool),
+            valid=np.ones((n,), bool),
+        )
+
+    def host_stats(self) -> Dict[str, Any]:
+        """Host-assembly observability payload: per-stage span stats
+        (assemble/pack/dispatch/device_wait) and cache hit/miss counters —
+        the source obs/metrics.MetricsCollector.sync_host_stats exports as
+        Prometheus series."""
+        caches: Dict[str, Any] = {"entity_rows": self._join_cache.stats()}
+        cache_stats = getattr(self.tokenizer, "cache_stats", None)
+        if cache_stats is not None:
+            caches["tokens"] = cache_stats()
+        return {"stages": self.spans.stats(), "caches": caches}
 
     # ----------------------------------------------------------------- scoring
     def dispatch(self, records: Sequence[Mapping[str, Any]],
@@ -422,10 +653,24 @@ class FraudScorer:
                                 features=self.last_features[:0],
                                 dispatch_ms=0.0)
         batch = self.assemble(records, now)
-        padded, mask, _ = pad_to_bucket(
-            batch, n, BATCH_BUCKETS, multiple_of=local_mesh_size(self.mesh)
-        )
-        # pad rows replicate row 0's True flag; the real mask is the padder's
+        return self.dispatch_assembled(batch, records, t0=t0)
+
+    def dispatch_assembled(self, batch: ScoreBatch,
+                           records: Sequence[Mapping[str, Any]],
+                           t0: Optional[float] = None) -> "PendingScore":
+        """Pad + pack + launch an already-assembled batch (the device half
+        of ``dispatch``). Split out so the overlapped assembler stage
+        (scoring/host_pipeline.py) can run ``assemble`` on its own thread
+        and hand the result here."""
+        if t0 is None:
+            t0 = time.perf_counter()
+        t_pack = time.perf_counter()
+        n = len(records)
+        size = bucket_for(n, BATCH_BUCKETS,
+                          multiple_of=local_mesh_size(self.mesh))
+        # write-into staging: pad rows replicate row 0, the real validity
+        # is the staging mask (same contract as pad_to_bucket)
+        padded, mask = self._staging.pad(batch, n, size)
         padded = padded.replace(valid=mask)
         # Transfer-optimal seam (core/packing.py): the 65-leaf ScoreBatch
         # collapses to 3 dense blobs (one h2d payload), the program returns
@@ -445,6 +690,8 @@ class FraudScorer:
             )
         blobs, spec = pack_tree(padded)
         sharded = shard_batch(self.mesh, blobs)
+        self.spans.record("pack", time.perf_counter() - t_pack)
+        t_disp = time.perf_counter()
 
         mv = self.effective_model_valid()
         rules_only = self._qos_rules_only
@@ -464,8 +711,9 @@ class FraudScorer:
                 out.copy_to_host_async()
             except AttributeError:  # backend without async copy support
                 pass
+        self.spans.record("dispatch", time.perf_counter() - t_disp)
         return PendingScore(records=list(records), n=n, out=out,
-                            features=self.last_features,
+                            features=np.asarray(batch.features),
                             dispatch_ms=(time.perf_counter() - t0) * 1000.0,
                             model_valid=mv, rules_only=rules_only)
 
@@ -483,6 +731,7 @@ class FraudScorer:
             return []
         t_fin = time.perf_counter()
         out = jax.device_get(pending.out)      # blocks until device is done
+        self.spans.record("device_wait", time.perf_counter() - t_fin)
         # processing time = assemble/dispatch + device wait; excludes any
         # pipeline queue wait between dispatch() returning and this call
         elapsed_ms = (pending.dispatch_ms

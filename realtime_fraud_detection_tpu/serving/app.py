@@ -79,6 +79,14 @@ class ServingApp:
             max_batch=sc.microbatch_max_size,
             deadline_ms=sc.microbatch_deadline_ms,
             budget=self.qos.budget if self.config.qos.enabled else None,
+            # two-phase pipelined scoring (serving.overlap_assembly): the
+            # drain task dispatches batch N+1 (cache check + assembly +
+            # device launch) while batch N still waits on the device in its
+            # finalize task — per-waiter results keep arriving in order
+            dispatch_fn=(self._dispatch_batch_sync
+                         if sc.overlap_assembly else None),
+            finalize_fn=(self._finalize_batch_sync
+                         if sc.overlap_assembly else None),
         )
         self.http = HttpServer(host if host is not None else sc.host,
                                port if port is not None else sc.port)
@@ -126,6 +134,14 @@ class ServingApp:
         so a concurrent caller assembles its batch while this one's compute
         is in flight (the double-buffered serving path, VERDICT r1 item 6).
         """
+        return self._finalize_batch_sync(self._dispatch_batch_sync(txns))
+
+    def _dispatch_batch_sync(self, txns) -> tuple:
+        """Pipeline stage 1 (executor thread): prediction-cache lookup +
+        assemble + device launch, WITHOUT blocking on the result. The
+        two-phase microbatcher (serving.overlap_assembly) calls this for
+        batch N+1 while batch N's ``_finalize_batch_sync`` is still waiting
+        on the device — host assembly overlaps device compute."""
         t0 = time.perf_counter()
         # serve idempotent retries from the prediction cache; only misses
         # go to the device (reference TTL-cache semantics)
@@ -141,12 +157,24 @@ class ServingApp:
             if cached:
                 to_score = [t for i, t in enumerate(txns) if i not in cached]
         try:
+            pending = None
             if to_score:
                 with self._score_lock:
                     pending = self.scorer.dispatch(to_score)
-                fresh = self.scorer.finalize(pending, lock=self._score_lock)
-            else:
-                pending, fresh = None, []
+        except Exception:
+            self.metrics.record_error("score")
+            raise
+        return (t0, txns, to_score, cached, pending)
+
+    def _finalize_batch_sync(self, ctx: tuple) -> List[Dict[str, Any]]:
+        """Pipeline stage 2 (executor thread): block on the device result,
+        then run the obs/experiment/cache tail and reassemble request
+        order."""
+        t0, txns, to_score, cached, pending = ctx
+        cache = self.prediction_cache
+        try:
+            fresh = (self.scorer.finalize(pending, lock=self._score_lock)
+                     if pending is not None else [])
         except Exception:
             self.metrics.record_error("score")
             raise
@@ -343,9 +371,14 @@ class ServingApp:
         return 200, payload
 
     async def _metrics(self, body, query) -> Tuple[int, Any]:
-        return 200, self.metrics.summary()
+        payload = self.metrics.summary()
+        payload["host_assembly"] = self.scorer.host_stats()
+        return 200, payload
 
     async def _metrics_prometheus(self, body, query) -> Tuple[int, Any]:
+        # mirror the scorer's host-assembly spans + cache counters into
+        # the registry at scrape time (cheap gauge sets)
+        self.metrics.sync_host_stats(self.scorer.host_stats())
         return 200, self.metrics.render_prometheus()
 
     async def _model_info(self, body, query) -> Tuple[int, Any]:

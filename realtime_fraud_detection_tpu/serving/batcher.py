@@ -39,6 +39,9 @@ class RequestMicrobatcher:
         deadline_ms: float = 5.0,
         max_queue: int = 10_000,
         budget=None,
+        dispatch_fn: Optional[Callable[[Sequence[Mapping[str, Any]]], Any]] = None,
+        finalize_fn: Optional[Callable[[Any], List[Dict[str, Any]]]] = None,
+        pipeline_depth: int = 2,
     ):
         self.score_fn = score_fn
         self.max_batch = max_batch
@@ -46,6 +49,18 @@ class RequestMicrobatcher:
         # optional qos.LatencyBudget: per-request enqueue timestamps bound
         # the close deadline by the oldest waiter's remaining budget
         self.budget = budget
+        # two-phase pipelined mode: with dispatch_fn + finalize_fn, the
+        # drain task runs dispatch (assembly + device launch) inline and
+        # hands the blocking finalize to its own ordered task, so batch
+        # N+1's host assembly overlaps batch N's device wait. At most
+        # ``pipeline_depth`` finalizes stay in flight (backpressure).
+        if (dispatch_fn is None) != (finalize_fn is None):
+            raise ValueError(
+                "dispatch_fn and finalize_fn must be provided together")
+        self.dispatch_fn = dispatch_fn
+        self.finalize_fn = finalize_fn
+        self.pipeline_depth = max(1, int(pipeline_depth))
+        self._inflight: List[asyncio.Task] = []
         self._queue: asyncio.Queue = asyncio.Queue(maxsize=max_queue)
         self._task: Optional[asyncio.Task] = None
         self._closed = False
@@ -139,8 +154,21 @@ class RequestMicrobatcher:
                 leftovers.append(item)
         for i in range(0, len(leftovers), self.max_batch):
             await self._score(loop, leftovers[i:i + self.max_batch])
+        await self._join_pipeline()
+
+    async def _join_pipeline(self) -> None:
+        """Wait out every in-flight finalize task (shutdown barrier)."""
+        while self._inflight:
+            task = self._inflight.pop(0)
+            try:
+                await task
+            except Exception:  # noqa: BLE001 — waiters got the exception
+                pass
 
     async def _score(self, loop, batch) -> None:
+        if self.dispatch_fn is not None:
+            await self._score_pipelined(loop, batch)
+            return
         txns = [t for t, _, _ in batch]
         futs = [f for _, f, _ in batch]
         try:
@@ -156,4 +184,54 @@ class RequestMicrobatcher:
         self.requests += len(batch)
         for f, r in zip(futs, results):
             if not f.done():                     # waiter may have timed out
+                f.set_result(r)
+
+    # ------------------------------------------------------ pipelined mode
+    async def _score_pipelined(self, loop, batch) -> None:
+        """Dispatch this batch now; finalize in an ordered background task.
+
+        The drain loop regains control right after dispatch returns, so it
+        collects (and dispatches) the NEXT batch while this one's finalize
+        blocks on the device in the executor — host assembly overlapped
+        with device compute, completion order preserved by chaining each
+        finalize behind its predecessor."""
+        txns = [t for t, _, _ in batch]
+        futs = [f for _, f, _ in batch]
+        try:
+            ctx = await loop.run_in_executor(None, self.dispatch_fn, txns)
+        except Exception as e:                   # noqa: BLE001
+            for f in futs:
+                if not f.done():
+                    f.set_exception(e)
+            return
+        prev = self._inflight[-1] if self._inflight else None
+        self._inflight.append(loop.create_task(
+            self._finalize(loop, prev, ctx, futs, len(batch))))
+        # bound the pipeline: wait for the oldest finalize once depth
+        # batches are in flight (device backpressure reaches the queue)
+        while len(self._inflight) > self.pipeline_depth:
+            task = self._inflight.pop(0)
+            try:
+                await task
+            except Exception:  # noqa: BLE001 — waiters got the exception
+                pass
+
+    async def _finalize(self, loop, prev: Optional[asyncio.Task], ctx,
+                        futs, n: int) -> None:
+        if prev is not None:
+            try:
+                await prev                       # completion stays in order
+            except Exception:  # noqa: BLE001
+                pass
+        try:
+            results = await loop.run_in_executor(None, self.finalize_fn, ctx)
+        except Exception as e:                   # noqa: BLE001
+            for f in futs:
+                if not f.done():
+                    f.set_exception(e)
+            return
+        self.batches += 1
+        self.requests += n
+        for f, r in zip(futs, results):
+            if not f.done():
                 f.set_result(r)

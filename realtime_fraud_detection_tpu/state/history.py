@@ -20,26 +20,121 @@ from typing import Dict, Iterable, List, Sequence, Tuple
 import numpy as np
 
 
+def _occurrence_index(slots: np.ndarray) -> np.ndarray:
+    """occ[i] = number of earlier rows in this batch with the same slot."""
+    occ = np.zeros((len(slots),), np.int64)
+    seen: Dict[int, int] = {}
+    get = seen.get
+    for i, s in enumerate(slots.tolist()):
+        k = get(s, 0)
+        occ[i] = k
+        seen[s] = k + 1
+    return occ
+
+
 class UserHistoryStore:
-    """Ring buffer of recent feature vectors per user."""
+    """Ring buffer of recent feature vectors per user.
+
+    Storage is one dense (capacity, T, F) slot table plus a uid->slot map
+    (not a dict of per-user rings): a whole microbatch appends with one
+    fancy-index scatter and gathers with one ``take``-style read, so the
+    host-assembly hot path does no per-record Python ring arithmetic.
+    """
 
     def __init__(self, seq_len: int = 10, feature_dim: int = 64):
         self.seq_len = seq_len
         self.feature_dim = feature_dim
-        self._rings: Dict[str, np.ndarray] = {}
-        self._count: Dict[str, int] = {}
+        self._slots: Dict[str, int] = {}
+        cap = 1024
+        self._table = np.zeros((cap, seq_len, feature_dim), np.float32)
+        self._counts = np.zeros((cap,), np.int64)
+
+    def __setstate__(self, state) -> None:
+        """Checkpoint migration: pre-slot-table snapshots pickled a dict of
+        per-user rings (``_rings``/``_count``). The ring layout is
+        position-identical (raw modular positions), so legacy rings copy
+        straight into slot-table rows."""
+        if "_rings" not in state:
+            self.__dict__.update(state)
+            return
+        self.seq_len = state["seq_len"]
+        self.feature_dim = state["feature_dim"]
+        self._slots = {}
+        cap = 1024
+        while cap < max(len(state["_rings"]), 1):
+            cap *= 2
+        self._table = np.zeros((cap, self.seq_len, self.feature_dim),
+                               np.float32)
+        self._counts = np.zeros((cap,), np.int64)
+        counts = state.get("_count", {})
+        for uid, ring in state["_rings"].items():
+            s = len(self._slots)
+            self._slots[uid] = s
+            self._table[s] = ring
+            self._counts[s] = int(counts.get(uid, 0))
+
+    def _grow(self, need: int) -> None:
+        cap = self._table.shape[0]
+        if need <= cap:
+            return
+        new_cap = cap
+        while new_cap < need:
+            new_cap *= 2
+        table = np.zeros((new_cap, self.seq_len, self.feature_dim), np.float32)
+        table[:cap] = self._table
+        counts = np.zeros((new_cap,), np.int64)
+        counts[:cap] = self._counts
+        self._table, self._counts = table, counts
+
+    def _slot_ids(self, user_ids: Sequence[str], create: bool) -> np.ndarray:
+        """uid -> slot indices; unknown uids get fresh slots (``create``)
+        or the sentinel -1, which ``_gather_slots`` masks to zero rows."""
+        slots = np.empty((len(user_ids),), np.int64)
+        get = self._slots.get
+        for i, uid in enumerate(user_ids):
+            s = get(uid)
+            if s is None:
+                if not create:
+                    s = -1
+                else:
+                    s = len(self._slots)
+                    self._slots[uid] = s
+            slots[i] = s
+        if create and self._slots:
+            self._grow(len(self._slots))
+        return slots
+
+    def _scatter_append(self, slots: np.ndarray, features: np.ndarray,
+                        occ: np.ndarray) -> None:
+        """Ring-write one row per (slot, occurrence); duplicate (slot, pos)
+        targets resolve last-write-wins in index order — exactly the
+        sequential ring semantics."""
+        pos = (self._counts[slots] + occ) % self.seq_len
+        self._table[slots, pos] = features
+        np.add.at(self._counts, slots, 1)
 
     def append_batch(self, user_ids: Sequence[str], features: np.ndarray) -> None:
         """Append one feature row per user (features: [B, F])."""
-        for i, uid in enumerate(user_ids):
-            ring = self._rings.get(uid)
-            if ring is None:
-                ring = np.zeros((self.seq_len, self.feature_dim), np.float32)
-                self._rings[uid] = ring
-                self._count[uid] = 0
-            pos = self._count[uid] % self.seq_len
-            ring[pos] = features[i]
-            self._count[uid] += 1
+        if not len(user_ids):
+            return
+        slots = self._slot_ids(user_ids, create=True)
+        occ = _occurrence_index(slots)
+        self._scatter_append(slots, np.asarray(features, np.float32), occ)
+
+    def _gather_slots(self, slots: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        """Dense (B, T, F) oldest-first readout for resolved slots
+        (slot -1 = never seen -> zero rows, length 0)."""
+        t = self.seq_len
+        safe = np.maximum(slots, 0)
+        counts = np.where(slots >= 0, self._counts[safe], 0)
+        k = np.minimum(counts, t)
+        # output position j holds ring[(count - k + (j - (T - k))) % T]
+        # for j >= T - k, zero-pad in front of that
+        jj = np.arange(t)[None, :] - (t - k[:, None])
+        src = (counts[:, None] - k[:, None] + np.maximum(jj, 0)) % t
+        vals = self._table[safe[:, None], src]
+        out = np.where((jj >= 0)[:, :, None], vals, np.float32(0.0))
+        return out, k.astype(np.int32)
 
     def append_and_gather(
         self, user_ids: Sequence[str], features: np.ndarray
@@ -50,15 +145,27 @@ class UserHistoryStore:
         against a history that ends with itself. A plain append_batch +
         gather would pair earlier rows with sequences containing later
         transactions of the same user (training-label leakage / mismatch).
+
+        Vectorized in occurrence rounds: round r appends + gathers every
+        row that is its user's (r+1)-th appearance in this batch, so a
+        user's later rows see its earlier rows' appends (identical to the
+        sequential per-row semantics) while the common all-unique batch
+        runs in exactly one vectorized round.
         """
         b = len(user_ids)
         out = np.zeros((b, self.seq_len, self.feature_dim), np.float32)
         lengths = np.zeros((b,), np.int32)
-        for i, uid in enumerate(user_ids):
-            self.append_batch([uid], features[i : i + 1])
-            seq, ln = self.gather([uid])
-            out[i] = seq[0]
-            lengths[i] = ln[0]
+        if not b:
+            return out, lengths
+        features = np.asarray(features, np.float32)
+        slots = self._slot_ids(user_ids, create=True)
+        occ = _occurrence_index(slots)
+        for r in range(int(occ.max()) + 1):
+            rows = np.nonzero(occ == r)[0]
+            rs = slots[rows]
+            self._scatter_append(rs, features[rows],
+                                 np.zeros((len(rows),), np.int64))
+            out[rows], lengths[rows] = self._gather_slots(rs)
         return out, lengths
 
     def gather(self, user_ids: Sequence[str]) -> Tuple[np.ndarray, np.ndarray]:
@@ -67,25 +174,13 @@ class UserHistoryStore:
         Users with fewer than T events are zero-padded at the FRONT so the
         most recent event is always the last step (what an LSTM reads out).
         """
-        b = len(user_ids)
-        out = np.zeros((b, self.seq_len, self.feature_dim), np.float32)
-        lengths = np.zeros((b,), np.int32)
-        for i, uid in enumerate(user_ids):
-            ring = self._rings.get(uid)
-            if ring is None:
-                continue
-            count = self._count[uid]
-            k = min(count, self.seq_len)
-            pos = count % self.seq_len
-            # ring unrolled oldest->newest
-            ordered = np.concatenate([ring[pos:], ring[:pos]], axis=0) if count >= self.seq_len \
-                else ring[:k]
-            out[i, self.seq_len - k:] = ordered[-k:]
-            lengths[i] = k
-        return out, lengths
+        if not len(user_ids):
+            return (np.zeros((0, self.seq_len, self.feature_dim), np.float32),
+                    np.zeros((0,), np.int32))
+        return self._gather_slots(self._slot_ids(user_ids, create=False))
 
     def __len__(self) -> int:
-        return len(self._rings)
+        return len(self._slots)
 
 
 class EntityGraphStore:

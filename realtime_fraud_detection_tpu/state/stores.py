@@ -136,11 +136,28 @@ class VelocityStore:
 
 
 class ProfileStore:
-    """User + merchant profile store (``user:{id}`` / ``merchant:{id}``)."""
+    """User + merchant profile store (``user:{id}`` / ``merchant:{id}``).
+
+    ``generation`` stamps every write: derived per-entity caches (the
+    columnar encoder's join-row cache, features/schema.EntityRowCache)
+    compare their stamp against it and drop stale rows instead of serving
+    a profile that has since been rewritten. The shared RESP-backed store
+    (state/shared.SharedProfileStore) deliberately has NO generation —
+    remote writers are invisible to this process, so caching over it
+    would be wrong and callers must check for the attribute.
+    """
 
     def __init__(self) -> None:
         self.users: Dict[str, Mapping[str, Any]] = {}
         self.merchants: Dict[str, Mapping[str, Any]] = {}
+        self.generation: int = 0
+
+    def __setstate__(self, state) -> None:
+        # checkpoint migration: host state is pickled object instances
+        # (checkpoint.py), and pre-host-plane snapshots lack ``generation``
+        self.__dict__.update(state)
+        if "generation" not in state:
+            self.generation = 0
 
     def seed(self, users: Mapping[str, Mapping[str, Any]] | None = None,
              merchants: Mapping[str, Mapping[str, Any]] | None = None) -> None:
@@ -150,6 +167,8 @@ class ProfileStore:
             self.users.update(users)
         if merchants:
             self.merchants.update(merchants)
+        if users or merchants:
+            self.generation += 1
 
     def get_user(self, user_id: str) -> Optional[Mapping[str, Any]]:
         return self.users.get(user_id)
@@ -159,9 +178,11 @@ class ProfileStore:
 
     def put_user(self, user_id: str, profile: Mapping[str, Any]) -> None:
         self.users[user_id] = profile
+        self.generation += 1
 
     def put_merchant(self, merchant_id: str, profile: Mapping[str, Any]) -> None:
         self.merchants[merchant_id] = profile
+        self.generation += 1
 
 
 class TransactionCache:

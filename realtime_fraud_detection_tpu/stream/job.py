@@ -66,6 +66,16 @@ class JobConfig:
     # to D-1 batches' updates (vs 1 at the default depth 2). Raise depth for
     # throughput soaks; keep 2 where freshest velocity features matter.
     pipeline_depth: int = 2
+    # overlapped host assembly (scoring/host_pipeline.AssemblerStage): a
+    # background thread runs assemble+dispatch for batch N+1 while this
+    # thread waits out batch N's device time in finalize — 2-stage software
+    # pipelining of the host→device seam. Admission/dedupe/ladder stay on
+    # THIS thread (decisions are never reordered or dropped); the velocity-
+    # staleness tradeoff is the same as pipeline_depth's, but the exact
+    # interleaving of batch N's write-back with batch N+1's assembly
+    # becomes timing-dependent — keep off where bit-reproducible replays
+    # matter, on for throughput.
+    overlap_assembly: bool = False
     # deadline-aware QoS plane (qos/): admission control, per-transaction
     # latency budgets (the assembler closes batches early when the oldest
     # waiter's budget runs low), and the degradation ladder fed by the
@@ -149,6 +159,16 @@ class StreamJob:
         self.analytics = (
             WindowedAnalytics(broker) if self.config.enable_analytics else None
         )
+        # overlapped host assembly: scorer.dispatch moves to a background
+        # stage thread; this thread keeps admission/dedupe/commit order
+        self._stage = None
+        if self.config.overlap_assembly:
+            from realtime_fraud_detection_tpu.scoring.host_pipeline import (
+                AssemblerStage,
+            )
+
+            self._stage = AssemblerStage(
+                scorer, depth=max(1, self.config.pipeline_depth))
         self.counters: Dict[str, int] = {
             "scored": 0, "alerts": 0, "batches": 0, "duplicates_skipped": 0,
             "errors": 0, "shed": 0,
@@ -230,13 +250,28 @@ class StreamJob:
             # which is being handled right now, not waiting
             self.qos.observe_backlog(
                 max(0, self.consumer.lag() - len(records)))
-            self.qos.apply_degradation(self.scorer)
+            if self._stage is not None:
+                # a ladder step writes the scorer's qos mask + rules_only
+                # flag; the stage thread reads both at dispatch — take the
+                # stage lock so one batch never sees a torn pair
+                with self._stage.lock:
+                    self.qos.apply_degradation(self.scorer)
+            else:
+                self.qos.apply_degradation(self.scorer)
         if not fresh:
             return _BatchCtx([], set(), None, positions, now, invalid,
                              cached_dups, shed)
         pending = None
         try:
-            pending = self.scorer.dispatch([r.value for r in fresh], now=now)
+            if self._stage is not None:
+                # background assembly: the handle resolves to a
+                # PendingScore at completion; errors surface there and take
+                # the same whole-batch degradation path
+                pending = self._stage.submit([r.value for r in fresh],
+                                             now=now)
+            else:
+                pending = self.scorer.dispatch([r.value for r in fresh],
+                                               now=now)
         except Exception:
             # whole-batch degradation fallback: score 0.5, REVIEW, keep the
             # stream alive; counted at completion
@@ -268,8 +303,17 @@ class StreamJob:
         scored_ok, results, feats = False, None, None
         if ctx.pending is not None:
             try:
-                results = self.scorer.finalize(ctx.pending, now=now)
-                feats = ctx.pending.features
+                pending = ctx.pending
+                if self._stage is not None and hasattr(pending, "result"):
+                    # overlapped mode: join the background assembly; an
+                    # assembly/dispatch error takes the same whole-batch
+                    # degradation path as a finalize error
+                    pending = pending.result()
+                results = self.scorer.finalize(
+                    pending, now=now,
+                    lock=self._stage.lock if self._stage is not None
+                    else None)
+                feats = pending.features
                 scored_ok = True
             except Exception:
                 results = None
@@ -512,6 +556,11 @@ class StreamJob:
         while in_flight:
             self.complete_batch(in_flight.popleft())
         return self.counters["scored"] - start_scored
+
+    def close(self) -> None:
+        """Stop the background assembler stage (no-op without overlap)."""
+        if self._stage is not None:
+            self._stage.close()
 
     def run_for(self, duration_s: float) -> int:
         """Process the stream for a wall-clock window (soak-test entry)."""

@@ -46,6 +46,19 @@ AND applied on min_isr-1 replicas (their WALs included) before the ack, so
 SIGKILL of the primary loses nothing acked — pinned by the kill-the-primary
 soak in tests/test_netbroker.py.
 
+Unacked-record guarantee (high watermark): consumers read only up to the
+per-partition high watermark, which advances when a produce reaches its
+min_isr copies. A produce that FAILS replication leaves its records on the
+local log above the watermark — no consumer ever observes a record whose
+producer was told it was not written (the read-uncommitted window is
+closed, not documented away). The tail re-surfaces only once a later
+``add_replica`` backlog sync makes it min_isr-replicated, consistent with
+the at-least-once producer-retry contract. Pinned watermarks are persisted
+(``hw.json``) so a primary RESTART cannot re-expose a WAL-replayed unacked
+tail either; the residual window is a crash between a failing produce's
+WAL fsync and its pin write (the same compromise as Kafka's checkpointed
+HW). Pinned by the regression tests in tests/test_netbroker.py.
+
 The wire format is 4-byte big-endian length + JSON — deliberately boring:
 the contract (offsets, groups, keyed partitions, commit-after-fanout) is
 what's load-bearing, and the contract tests run identically against
@@ -190,6 +203,20 @@ class BrokerServer:
         self.log_dir = Path(log_dir) if log_dir else None
         self.role = role
         self.min_isr = int(min_isr)
+        # High watermark per (topic, partition): consumers only ever read
+        # up to it. It advances when a produce reaches min_isr in-sync
+        # copies, so a record whose replication FAILED sits on the local
+        # log above the watermark — never exposed to a consumer before its
+        # durability ack (Kafka's HW semantics; closes the read-uncommitted
+        # window where a consumer could act on a record whose producer was
+        # told it was NOT written). A partition with no entry is fully
+        # visible. Because the WAL is written BEFORE replication, a pinned
+        # watermark (hw < log end) is also persisted to ``hw.json`` —
+        # without that, a restart would replay the fsync'd-but-unacked
+        # tail as visible. Only the pin set is persisted (rare,
+        # failure-path writes; the steady state costs no I/O).
+        self._hw: Dict[tuple, int] = {}
+        self._persisted_pins: Dict[str, int] = {}
         self._replicas: List[_ReplicaLink] = []
         self._conns: set = set()          # live handler sockets (for stop())
         self._seg_files: Dict[tuple, Any] = {}
@@ -275,13 +302,94 @@ class BrokerServer:
                 for f in touched:
                     f.flush()
                     os.fsync(f.fileno())
+            # the watermark shipped WITH the records is the pre-produce
+            # visible end: these records are not acked yet, so a replica
+            # applying them must not expose them to its readers. The same
+            # watermark is PRE-PINNED locally BEFORE the append: fetch/lag
+            # handlers run on other threads without _io_lock, and a
+            # partition with no _hw entry defaults to the physical log end
+            # — without the pre-pin, a fetch racing the replication round
+            # trip would serve the not-yet-acked record.
+            pre_hw = {p: self._visible_end(topic, p)
+                      for p in range(len(b._logs(topic)))}
+            for p, hw in pre_hw.items():
+                self._hw[(topic, p)] = hw
             recs = [b.append(topic, part, v, k, ts)
                     for part, k, v, ts in planned]
-            self._replicate(topic, recs)
+            try:
+                self._replicate(topic, recs, pre_hw)
+            except Exception:
+                # NOT acked: the pre-pinned watermark stays — consumers
+                # never see the unreplicated tail (it stays on the local
+                # log; a successful later replication round — e.g.
+                # add_replica's backlog sync — re-advances past it). The
+                # pin is persisted so a RESTART cannot re-expose the
+                # WAL-replayed tail either.
+                self._sync_hw_pins()
+                raise
+            for p, log in enumerate(b._logs(topic)):
+                self._hw[(topic, p)] = len(log.records)
+            self._sync_hw_pins()
+            # acked: let replicas expose the records too (their visible end
+            # follows the primary's watermark, never their raw log end)
+            self._sync_replica_hw(topic)
             return recs
 
+    def _sync_replica_hw(self, topic: str) -> None:
+        """Push the primary's committed watermark to replicas after an ack.
+        A replica that misses the sync just serves a slightly stale (more
+        conservative) view until the next one — never the unsafe
+        direction — so errors here do not shrink the ISR. Caller holds
+        ``_io_lock``. COST: one extra frame per replica per acked produce,
+        chosen deliberately — Kafka piggybacks the HW on the next data
+        frame and lets follower reads lag one produce; this broker's
+        replicas promise read-your-ack freshness (tests pin it), and the
+        produce path is already synchronous per replica, so the extra
+        frame is a constant factor, not a new round-trip class."""
+        if not self._replicas:
+            return
+        hws = {str(p): self._visible_end(topic, p)
+               for p in range(len(self.broker._logs(topic)))}
+        for link in self._replicas:
+            try:
+                link.call({"op": "hw_sync", "topic": topic, "hws": hws})
+            except Exception:  # noqa: BLE001 — stale-but-safe on failure
+                pass
+
+    def _sync_hw_pins(self) -> None:
+        """Persist the PIN SET — partitions whose watermark sits below the
+        log end (an unacked, replication-failed tail). Written only when
+        the set changes (pins appear on the failure path and clear on
+        re-sync), so the acked steady state never touches this file.
+        Residual window: a crash between a produce's WAL fsync and this
+        pin write re-exposes that produce's tail on restart — the same
+        at-least-once compromise as Kafka's periodically-checkpointed HW.
+        Caller holds ``_io_lock``."""
+        if self.log_dir is None:
+            return
+        pins = {
+            f"{t}\x00{p}": hw
+            for (t, p), hw in self._hw.items()
+            if p < len(self.broker._logs(t))
+            and hw < len(self.broker._logs(t)[p].records)
+        }
+        if pins == self._persisted_pins:
+            return
+        tmp = self.log_dir / "hw.json.tmp"
+        tmp.write_text(json.dumps(pins))
+        tmp.replace(self.log_dir / "hw.json")
+        self._persisted_pins = pins
+
+    def _visible_end(self, topic: str, part: int) -> int:
+        """Consumer-visible end offset: the high watermark when one is
+        tracked, else the physical log end."""
+        logs = self.broker._logs(topic)
+        end = len(logs[part].records) if part < len(logs) else 0
+        return min(end, self._hw.get((topic, part), end))
+
     # ---------------------------------------------------------- replication
-    def _replicate(self, topic: str, recs: List[Record]) -> None:
+    def _replicate(self, topic: str, recs: List[Record],
+                   ship_hw: Optional[Dict[int, int]] = None) -> None:
         """Ship freshly appended records to every replica, synchronously.
         Caller holds ``_io_lock``. A replica that errors is dropped from
         the ISR; if fewer than ``min_isr`` copies hold the records, the
@@ -301,7 +409,13 @@ class BrokerServer:
                 # that never received a record, or key routing diverges
                 # after a promote
                 "n_parts": len(self.broker._logs(topic)),
-                "parts": [{"p": p, "base": rows[0]["o"], "records": rows}
+                # the primary's CURRENT watermark rides along too: the
+                # replica's visible end follows the primary's (a record
+                # being shipped is not yet acked — the replica must not
+                # serve reads past what the primary has committed)
+                "parts": [{"p": p, "base": rows[0]["o"], "records": rows,
+                           "hw": (ship_hw.get(p, 0) if ship_hw is not None
+                                  else self._visible_end(topic, p))}
                           for p, rows in parts.items()],
             }
             alive = []
@@ -341,16 +455,28 @@ class BrokerServer:
                         ]
                         link.call({"op": "replicate", "topic": t,
                                    "parts": [{"p": p, "base": rows[0]["o"],
-                                              "records": rows}]})
+                                              "records": rows,
+                                              "hw": self._visible_end(
+                                                  t, p)}]})
                         start += len(rows)
             link.call({"op": "offsets_sync", "committed": {
                 f"{g}\x00{t}\x00{p}": off
                 for (g, t, p), off in b._committed.items()
             }})
             self._replicas.append(link)
+            if 1 + len(self._replicas) >= self.min_isr:
+                # the full backlog (any previously unacked tail included)
+                # now holds on min_isr copies: expose it, replicas included
+                for t in list(b._topics):
+                    for p, log in enumerate(b._logs(t)):
+                        self._hw[(t, p)] = len(log.records)
+                self._sync_hw_pins()
+                for t in list(b._topics):
+                    self._sync_replica_hw(t)
 
     def _apply_replicated(self, topic: str, part: int, base: int,
-                          rows: List[Mapping[str, Any]]) -> None:
+                          rows: List[Mapping[str, Any]],
+                          primary_hw: Optional[int] = None) -> None:
         """Replica side: append shipped records at their primary offsets,
         WAL-first when durable. Idempotent for already-held offsets; a gap
         (shipped offset beyond local end) is refused loudly — the primary
@@ -382,6 +508,22 @@ class BrokerServer:
             for _, d in fresh:
                 b.append(topic, part, d.get("v"), d.get("k"),
                          d.get("ts", 0.0))
+            # visibility follows the PRIMARY's watermark, not the local
+            # log end: the shipped records are not yet acked (the primary
+            # is still collecting min_isr acks when this runs), so a read
+            # from this warm standby must not run ahead of what the
+            # primary serves. Legacy replicate frames without "hw" keep
+            # the old expose-on-apply behavior.
+            self._hw[(topic, part)] = (
+                min(int(primary_hw), len(log.records))
+                if primary_hw is not None else len(log.records))
+            # deliberately NOT persisted here: on the acked path this pin
+            # is transient (the post-ack hw_sync clears it milliseconds
+            # later), and persisting would cost two hw.json writes per
+            # produce on a durable replica's synchronous path. The cost: a
+            # replica crashing inside that window replays the applied-but-
+            # not-yet-acked records as visible — the same bounded
+            # WAL-vs-pin compromise the primary documents.
 
     def _forward_commit(self, group: str, wire: Mapping[str, Any]) -> None:
         """Ship an offset commit to replicas so a promoted replica resumes
@@ -413,7 +555,21 @@ class BrokerServer:
 
     def promote(self) -> None:
         """Replica -> primary: start accepting writes. The log, offsets and
-        WAL carry over as-is (they were kept in sync by the shipping lane)."""
+        WAL carry over as-is (they were kept in sync by the shipping lane).
+
+        Promotion commits the local log tail: the new primary's log IS the
+        partition's truth, so the watermark advances to the log end — the
+        same retroactive commit a Kafka leader election performs. A record
+        whose producer was told "not written" (its ack round died with the
+        old primary) may therefore surface after failover; producer
+        retries then duplicate it, which is the documented at-least-once
+        contract (consumers dedupe by transaction id).
+        """
+        with self._io_lock:
+            for t in list(self.broker._topics):
+                for p, log in enumerate(self.broker._logs(t)):
+                    self._hw[(t, p)] = len(log.records)
+            self._sync_hw_pins()
         self.role = "primary"
 
     def isr_size(self) -> int:
@@ -458,6 +614,15 @@ class BrokerServer:
             for key, off in json.loads(off_path.read_text()).items():
                 g, t, p = key.split("\x00")
                 self.broker._committed[(g, t, int(p))] = int(off)
+        hw_path = self.log_dir / "hw.json"
+        if hw_path.exists():
+            # re-pin watermarks for partitions whose WAL tail was never
+            # acked: the replayed records stay invisible until a replica
+            # re-sync makes them min_isr-replicated
+            self._persisted_pins = json.loads(hw_path.read_text())
+            for key, hw in self._persisted_pins.items():
+                t, p = key.split("\x00")
+                self._hw[(t, int(p))] = int(hw)
 
     # ------------------------------------------------------------- dispatch
     _WRITE_OPS = frozenset({"produce", "produce_batch", "commit",
@@ -477,8 +642,25 @@ class BrokerServer:
             if n_parts:
                 self._grow_topic(req["topic"], int(n_parts))
             for blob in req["parts"]:
+                hw = blob.get("hw")
                 self._apply_replicated(req["topic"], int(blob["p"]),
-                                       int(blob["base"]), blob["records"])
+                                       int(blob["base"]), blob["records"],
+                                       primary_hw=(int(hw) if hw is not None
+                                                   else None))
+            return {}
+        if op == "hw_sync":
+            # post-ack watermark push: expose records the primary just
+            # committed (clamped to the local log — never past what this
+            # replica actually holds)
+            topic = req["topic"]
+            logs = self.broker._logs(topic)
+            with self._io_lock:
+                for p_s, hw in req["hws"].items():
+                    p = int(p_s)
+                    if p < len(logs):
+                        self._hw[(topic, p)] = min(
+                            int(hw), len(logs[p].records))
+                self._sync_hw_pins()
             return {}
         if op == "sync_topic":
             self._grow_topic(req["name"], int(req["partitions"]))
@@ -512,8 +694,13 @@ class BrokerServer:
                 (item.get("k"), item["v"], None) for item in req["records"]])
             return {"n": len(recs)}
         if op == "fetch":
+            # reads stop at the high watermark: a record above it exists on
+            # the log but its produce was never acked (min_isr not reached)
+            end = self._visible_end(req["topic"], req["partition"])
+            limit = min(int(req["max_records"]),
+                        max(0, end - int(req["offset"])))
             recs = b.read(req["topic"], req["partition"], req["offset"],
-                          req["max_records"])
+                          limit) if limit > 0 else []
             return {"records": [
                 {"p": r.partition, "o": r.offset, "k": r.key, "v": r.value,
                  "ts": r.timestamp} for r in recs]}
@@ -532,9 +719,18 @@ class BrokerServer:
         if op == "partitions":
             return {"n": b.partitions(req["topic"])}
         if op == "end_offsets":
+            # replication internals (add_replica's catch-up) need PHYSICAL
+            # ends; consumer-facing visibility is enforced at fetch/lag
             return {"ends": b.end_offsets(req["topic"])}
         if op == "lag":
-            return {"lag": b.lag(req["group"], req["topic"])}
+            # lag against the VISIBLE ends, matching what fetch can serve —
+            # otherwise a drain loop would spin forever on an unacked tail
+            topic, group = req["topic"], req["group"]
+            total = 0
+            for p in range(len(b._logs(topic))):
+                total += max(0, self._visible_end(topic, p)
+                             - b.committed(group, topic, p))
+            return {"lag": total}
         if op == "create_topic":
             b.create_topic(req["name"], req["partitions"])
             # layout changes ship to replicas like records do: a topic

@@ -104,6 +104,18 @@ class ServingConfig:
     # EnsembleConfig.cache_ttl_seconds / cache_max_entries (the reference
     # keeps the cache knobs on the ensemble config; one source of truth).
     enable_prediction_cache: bool = True
+    # Two-phase pipelined microbatcher (serving/batcher.py): dispatch batch
+    # N+1 (cache check + host assembly + device launch) while batch N's
+    # finalize still waits on the device — host assembly overlaps device
+    # compute. Results stay in per-request order; off by default so the
+    # single-phase path remains the reproducible baseline. TRADEOFF: the
+    # prediction cache's idempotent-retry window narrows — a retry of a
+    # transaction arriving while its first copy is between dispatch and
+    # finalize (the one-batch in-flight window, ~the device latency) misses
+    # the cache and is scored + written back again (velocity counts that
+    # transaction twice). The serial path closes that window by strict
+    # put-before-next-lookup ordering.
+    overlap_assembly: bool = False
 
 
 @dataclass

@@ -247,6 +247,111 @@ class TestReplication:
             client.close()
             primary.stop()
 
+    def test_unacked_records_invisible_until_replicated(self):
+        """Read-committed regression (ADVICE r5): a produce that fails
+        min-ISR replication must NOT surface to consumers — the record
+        sits above the high watermark until a later backlog sync makes it
+        min_isr-replicated, at which point it becomes visible (at-least-
+        once, never read-uncommitted)."""
+        primary = BrokerServer(port=0, min_isr=2).start()
+        client = NetBrokerClient(port=primary.port)
+        replica = BrokerServer(port=0, role="replica").start()
+        try:
+            primary.add_replica("127.0.0.1", replica.port)
+            client.produce(T.TRANSACTIONS, {"n": "acked"}, key="k")
+            consumer = client.consumer([T.TRANSACTIONS], "g-hw")
+            assert [r.value["n"] for r in consumer.poll(10)] == ["acked"]
+            consumer.commit()
+
+            replica.stop()
+            with pytest.raises(RuntimeError, match="NotEnoughReplicas"):
+                client.produce(T.TRANSACTIONS, {"n": "unacked"}, key="k")
+            # the failed record is on the primary's log but must be
+            # invisible: no fetch results, no phantom lag to spin on
+            assert consumer.poll(10) == []
+            assert client.lag("g-hw", T.TRANSACTIONS) == 0
+
+            # a fresh replica re-syncs the backlog -> the tail is now on
+            # min_isr copies and becomes visible (at-least-once)
+            replica2 = BrokerServer(port=0, role="replica").start()
+            try:
+                primary.add_replica("127.0.0.1", replica2.port)
+                assert [r.value["n"] for r in consumer.poll(10)] == \
+                    ["unacked"]
+            finally:
+                replica2.stop()
+        finally:
+            client.close()
+            primary.stop()
+
+    def test_replica_reads_follow_primary_watermark(self):
+        """A replica that APPLIED a record whose produce still failed
+        min-ISR (min_isr=3, one replica short) must not serve it to
+        readers — its visible end follows the primary's shipped watermark,
+        not its own log end. promote() then commits the tail (the Kafka
+        leader-election retroactive commit), making it readable."""
+        primary = BrokerServer(port=0, min_isr=3).start()
+        replica = BrokerServer(port=0, role="replica").start()
+        client = NetBrokerClient(port=primary.port)
+        rclient = NetBrokerClient(port=replica.port)
+        try:
+            primary.add_replica("127.0.0.1", replica.port)
+            with pytest.raises(RuntimeError, match="NotEnoughReplicas"):
+                client.produce(T.TRANSACTIONS, {"n": "partial"}, key="k")
+            # the record IS on the replica's log (it applied the ship) ...
+            assert sum(replica.broker.end_offsets(T.TRANSACTIONS)) == 1
+            # ... but neither side serves it to a consumer
+            assert rclient.consumer([T.TRANSACTIONS], "g-a").poll(10) == []
+            assert client.consumer([T.TRANSACTIONS], "g-b").poll(10) == []
+            replica.promote()
+            assert [r.value["n"] for r in
+                    rclient.consumer([T.TRANSACTIONS], "g-c").poll(10)] == \
+                ["partial"]
+        finally:
+            client.close()
+            rclient.close()
+            primary.stop()
+            replica.stop()
+
+    def test_unacked_tail_stays_invisible_across_restart(self, tmp_path):
+        """The watermark pin survives a primary restart: the WAL holds the
+        replication-failed record (written before replication), so replay
+        must re-pin it invisible rather than serve it (code-review r6
+        finding — in-memory-only HW re-exposed the tail)."""
+        log_dir = str(tmp_path / "wal")
+        primary = BrokerServer(port=0, min_isr=2, log_dir=log_dir).start()
+        client = NetBrokerClient(port=primary.port)
+        replica = BrokerServer(port=0, role="replica").start()
+        try:
+            primary.add_replica("127.0.0.1", replica.port)
+            client.produce(T.TRANSACTIONS, {"n": "acked"}, key="k")
+            replica.stop()
+            with pytest.raises(RuntimeError, match="NotEnoughReplicas"):
+                client.produce(T.TRANSACTIONS, {"n": "unacked"}, key="k")
+        finally:
+            client.close()
+            primary.stop()
+
+        restarted = BrokerServer(port=0, min_isr=2, log_dir=log_dir).start()
+        client = NetBrokerClient(port=restarted.port)
+        try:
+            consumer = client.consumer([T.TRANSACTIONS], "g-restart")
+            # the WAL replayed BOTH records, but only the acked one is
+            # visible: the pin persisted across the restart
+            assert [r.value["n"] for r in consumer.poll(10)] == ["acked"]
+            assert client.lag("g-restart", T.TRANSACTIONS) == 1
+            # a replica re-sync makes the tail min_isr-replicated again
+            replica2 = BrokerServer(port=0, role="replica").start()
+            try:
+                restarted.add_replica("127.0.0.1", replica2.port)
+                assert [r.value["n"] for r in consumer.poll(10)] == \
+                    ["unacked"]
+            finally:
+                replica2.stop()
+        finally:
+            client.close()
+            restarted.stop()
+
     def test_late_replica_catches_up_backlog(self):
         """add_replica on a primary with history pushes the whole backlog +
         group offsets before admitting the replica to the ISR."""
