@@ -170,6 +170,12 @@ def _compact_summary(result: dict) -> dict:
                      "accuracy": quality.get("accuracy")}
                     if quality else None),
         "mfu": mfu,
+        # compact arch stamp: layers x hidden / vocab @ seq (full record
+        # in the preceding line's text_encoder)
+        "text_encoder": (
+            f"{te['num_layers']}x{te['hidden_size']}"
+            f"/{te['vocab_size']}@{te['text_len']}"
+            if (te := result.get("text_encoder")) else None),
         "summary_of": "full result JSON on the preceding stdout line",
     }
     if result.get("latest_committed_tpu_capture"):
@@ -189,7 +195,7 @@ def _compact_summary(result: dict) -> dict:
     while len(line.encode()) >= 2048:
         for victim in ("configs_txn_per_s", "operating_point", "quality",
                        "host_assembly", "latest_committed_tpu_capture",
-                       "error"):
+                       "text_encoder", "error"):
             if compact.pop(victim, None) is not None:
                 break
         else:
@@ -622,6 +628,17 @@ def run_bench() -> None:
     # trimmed to 2 layers on CPU so fallback runs stay tractable.
     bert_config = BertConfig() if on_tpu else BertConfig(num_layers=2)
     sc = ScorerConfig(text_len=64)
+    # record the EXACT text-encoder architecture these numbers were
+    # measured with (VERDICT Weak #5: a bench model and a quality-artifact
+    # model must be comparable by inspection, never by assumption)
+    result["text_encoder"] = {
+        "num_layers": bert_config.num_layers,
+        "hidden_size": bert_config.hidden_size,
+        "intermediate_size": bert_config.intermediate_size,
+        "num_heads": bert_config.num_heads,
+        "vocab_size": bert_config.vocab_size,
+        "text_len": sc.text_len,
+    }
     # Iteration scale: full on TPU; reduced on the CPU fallback so a wedged
     # relay still yields a complete JSON well inside the orchestrator budget.
     it = (lambda n: n) if on_tpu else (lambda n: max(3, n // 30))

@@ -147,6 +147,28 @@ def cmd_run_job(args: argparse.Namespace) -> int:
     scorer = FraudScorer(scorer_config=ScorerConfig(),
                          state_client=state_client)
     scorer.seed_profiles(gen.users.profiles(), gen.merchants.profiles())
+    feedback_plane = None
+    if getattr(args, "feedback", False):
+        # continuous-learning plane: the job feeds emitted predictions into
+        # the label join; this entry point also plays the label-producer
+        # role (delayed ground truth from the simulator onto the labels
+        # topic), so a self-generating run closes the loop end to end
+        from realtime_fraud_detection_tpu.feedback import FeedbackPlane
+        from realtime_fraud_detection_tpu.obs import (
+            DriftConfig,
+            FeatureDriftMonitor,
+        )
+        from realtime_fraud_detection_tpu.utils.config import (
+            FeedbackSettings,
+        )
+
+        settings = FeedbackSettings(
+            enabled=True,
+            label_delay_scale=args.feedback_delay_scale)
+        feedback_plane = FeedbackPlane(
+            settings, scorer=scorer, config=scorer.config,
+            drift_monitor=FeatureDriftMonitor(
+                DriftConfig(num_features=scorer.sc.feature_dim)))
     qos_settings = None
     if getattr(args, "qos", False):
         from realtime_fraud_detection_tpu.utils.config import QosSettings
@@ -158,6 +180,7 @@ def cmd_run_job(args: argparse.Namespace) -> int:
         max_batch=args.batch, enable_analytics=args.analytics,
         enable_enrichment=args.enrichment,
         pipeline_depth=args.pipeline_depth, qos=qos_settings,
+        feedback=feedback_plane,
         overlap_assembly=getattr(args, "overlap_assembly", False)))
 
     metadata: Optional[MetadataStore] = None
@@ -210,6 +233,13 @@ def cmd_run_job(args: argparse.Namespace) -> int:
             records = gen.generate_batch(chunk)
             broker.produce_batch(T.TRANSACTIONS, records,
                                  key_fn=lambda r: str(r["user_id"]))
+            if feedback_plane is not None:
+                # label-producer role: delayed ground truth for the chunk
+                broker.produce_batch(
+                    T.LABELS,
+                    gen.label_events(records,
+                                     delay_scale=args.feedback_delay_scale),
+                    key_fn=lambda e: str(e["transaction_id"]))
             produced += chunk
             scored += job.run_until_drained()
             step += 1
@@ -232,6 +262,14 @@ def cmd_run_job(args: argparse.Namespace) -> int:
         "txn_per_s": round(scored / dt, 1),
         "counters": job.counters,
     }
+    if feedback_plane is not None:
+        snap = feedback_plane.snapshot()
+        summary["feedback"] = {
+            "prequential_sliding": snap["prequential"]["sliding"],
+            "labels_matched": snap["label_join"]["matched"],
+            "buffer": snap["buffer"]["size"],
+            "policy": snap["policy"],
+        }
     if job.analytics is not None:
         summary["analytics"] = {
             k: v["fired"] for k, v in job.analytics.stats().items()}
@@ -299,8 +337,24 @@ def cmd_serve(args: argparse.Namespace) -> int:
     if args.checkpoint_dir:
         from realtime_fraud_detection_tpu.checkpoint import CheckpointManager
 
-        ck = CheckpointManager(args.checkpoint_dir).restore_into_scorer(
-            app.scorer)
+        mgr = CheckpointManager(args.checkpoint_dir)
+        if getattr(args, "quality_artifact", ""):
+            # refuse to combine a checkpoint and an artifact recording
+            # DIFFERENT text-encoder architectures (VERDICT Weak #5): the
+            # blend was measured against one model, the restored params
+            # are another. --allow-arch-mismatch overrides explicitly.
+            art_tm = Config.load_artifact_text_model(args.quality_artifact)
+            ck_tm = (mgr.manifest().get("metadata") or {}).get("text_model")
+            if (art_tm is not None and ck_tm is not None
+                    and dict(art_tm) != dict(ck_tm)
+                    and not getattr(args, "allow_arch_mismatch", False)):
+                print(f"text-encoder architecture mismatch: artifact "
+                      f"{args.quality_artifact} records {art_tm}, "
+                      f"checkpoint {args.checkpoint_dir} records {ck_tm}; "
+                      f"pass --allow-arch-mismatch to combine anyway",
+                      file=sys.stderr)
+                return 2
+        ck = mgr.restore_into_scorer(app.scorer)
         print(f"restored checkpoint step {ck.step} from "
               f"{args.checkpoint_dir}", file=sys.stderr)
     print(f"serving on {config.serving.host}:{config.serving.port}",
@@ -730,6 +784,32 @@ def cmd_qos_drill(args: argparse.Namespace) -> int:
     return 0 if summary["p99_within_budget"] else 1
 
 
+def cmd_feedback_drill(args: argparse.Namespace) -> int:
+    """Deterministic closed-loop continuous-learning demo (feedback/
+    drill.py): virtual clock, real scorer + retraining. Prints the full
+    summary, then a compact (<2 KB) parseable verdict as the FINAL stdout
+    line (the bench.py convention). Exit 1 unless the whole loop passed:
+    drift injected -> prequential AUC dip -> retrain trigger -> gate
+    rejects the negative control bit-identically -> genuine candidate
+    promoted only on gate-pass -> AUC recovers."""
+    import dataclasses as _dc
+
+    from realtime_fraud_detection_tpu.feedback.drill import (
+        FeedbackDrillConfig,
+        compact_drill_summary,
+        run_feedback_drill,
+    )
+
+    cfg = (FeedbackDrillConfig.fast() if args.fast
+           else FeedbackDrillConfig())
+    cfg = _dc.replace(cfg, seed=args.seed, drift_rate=args.drift_rate)
+    summary = run_feedback_drill(cfg)
+    print(json.dumps(summary), flush=True)
+    print(json.dumps(compact_drill_summary(summary),
+                     separators=(",", ":")), flush=True)
+    return 0 if summary["passed"] else 1
+
+
 def cmd_health_check(args: argparse.Namespace) -> int:
     """Probe a running scoring service (health-check.sh analog)."""
     import urllib.error
@@ -826,6 +906,13 @@ def build_parser() -> argparse.ArgumentParser:
                          "N+1 while batch N runs on device (scoring/"
                          "host_pipeline.py; see JobConfig.overlap_assembly "
                          "for the staleness tradeoff)")
+    sp.add_argument("--feedback", action="store_true",
+                    help="enable the continuous-learning plane: delayed "
+                         "labels -> prequential metrics -> drift-gated "
+                         "retrain-and-promote (feedback/)")
+    sp.add_argument("--feedback-delay-scale", type=float, default=1e-4,
+                    help="compresses the chargeback label-delay "
+                         "distribution (1.0 = realistic days)")
     sp.set_defaults(fn=cmd_run_job)
 
     sp = sub.add_parser("serve", help="run the scoring HTTP service")
@@ -852,6 +939,10 @@ def build_parser() -> argparse.ArgumentParser:
                     help="two-phase pipelined microbatcher: dispatch batch "
                          "N+1 while batch N waits on the device "
                          "(serving.overlap_assembly)")
+    sp.add_argument("--allow-arch-mismatch", action="store_true",
+                    help="combine a checkpoint and quality artifact even "
+                         "when their recorded text-encoder architectures "
+                         "differ (refused by default)")
     sp.set_defaults(fn=cmd_serve)
 
     sp = sub.add_parser("train", help="train tree models on synthetic data")
@@ -975,6 +1066,18 @@ def build_parser() -> argparse.ArgumentParser:
                          "class")
     sp.add_argument("--seed", type=int, default=7)
     sp.set_defaults(fn=cmd_qos_drill)
+
+    sp = sub.add_parser("feedback-drill",
+                        help="deterministic closed-loop continuous-"
+                             "learning demo (virtual clock, real "
+                             "retraining)")
+    sp.add_argument("--fast", action="store_true",
+                    help="tier-1 sizes (the CI smoke configuration)")
+    sp.add_argument("--seed", type=int, default=5)
+    sp.add_argument("--drift-rate", type=float, default=0.08,
+                    help="fraction of the stream turned into the drifted "
+                         "fraud pattern")
+    sp.set_defaults(fn=cmd_feedback_drill)
 
     sp = sub.add_parser("bench", help="run the TPU benchmark")
     sp.set_defaults(fn=cmd_bench)

@@ -153,6 +153,43 @@ def combine_predictions(
     return out
 
 
+def blend_branch_scores(
+    scores_by_branch: Dict[str, "object"],
+    weights_by_name: Dict[str, float],
+    strategy: str = "weighted_average",
+):
+    """Host-side serving-parity blend over NAMED branch score arrays.
+
+    The ONE recipe shared by the offline protocol (training/blend_eval.py)
+    and the continuous-learning gate (feedback/policy.py): branch scores
+    are laid out in MODEL_NAMES order, weights map onto EnsembleParams,
+    validity = (weight > 0 AND the branch produced scores), and the SAME
+    jitted ``combine_predictions`` the fused device program runs does the
+    math — at any strategy, including the stacked combiner. Returns the
+    fraud-probability vector as a NumPy array.
+    """
+    import numpy as np
+
+    from realtime_fraud_detection_tpu.scoring import MODEL_NAMES
+
+    if strategy not in STRATEGIES:
+        raise ValueError(
+            f"strategy must be one of {STRATEGIES}, got {strategy!r}")
+    base = EnsembleParams.from_config(Config(), list(MODEL_NAMES))
+    w = jnp.asarray([float(weights_by_name.get(n, 0.0))
+                     for n in MODEL_NAMES], jnp.float32)
+    params = base.replace(weights=w, strategy=STRATEGIES.index(strategy))
+    valid = np.asarray([weights_by_name.get(n, 0.0) > 0.0
+                        and n in scores_by_branch for n in MODEL_NAMES])
+    n_rows = len(next(iter(scores_by_branch.values())))
+    preds = np.stack(
+        [np.asarray(scores_by_branch.get(name, np.zeros(n_rows)),
+                    np.float32) for name in MODEL_NAMES], axis=1)
+    out = combine_predictions(jnp.asarray(preds), jnp.asarray(valid),
+                              params, with_confidences=False)
+    return np.asarray(out["fraud_probability"])
+
+
 def ensemble_decision(
     prob: jax.Array, confidence: jax.Array, confidence_threshold: float = 0.7,
     decline: float = DECLINE_THRESHOLD_DEFAULT,

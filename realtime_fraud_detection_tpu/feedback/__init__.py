@@ -1,0 +1,34 @@
+"""Closed-loop continuous-learning plane.
+
+Turns the scoring pipeline into a closed loop: delayed ground-truth labels
+(sim/simulator.py label events) join back to emitted predictions
+(feedback/labels.LabelJoin), feed prequential test-then-train quality
+metrics (feedback/prequential.py) and a bounded labeled-example buffer
+(state/labeled.py); drift or prequential degradation triggers a background
+retrain whose candidate blend must pass the promotion gate before it
+reaches the serving models through the /reload-models recipe
+(feedback/policy.py, feedback/plane.py). ``rtfd feedback-drill`` runs the
+whole loop deterministically on a virtual clock (feedback/drill.py).
+"""
+
+from realtime_fraud_detection_tpu.feedback.labels import (  # noqa: F401
+    LabelJoin,
+    make_label_events,
+)
+from realtime_fraud_detection_tpu.feedback.prequential import (  # noqa: F401
+    FadingAUC,
+    PrequentialEvaluator,
+    sliding_auc,
+    weighted_auc,
+)
+from realtime_fraud_detection_tpu.feedback.policy import (  # noqa: F401
+    PromotionGate,
+    Retrainer,
+    RetrainPolicy,
+)
+from realtime_fraud_detection_tpu.feedback.plane import (  # noqa: F401
+    FeedbackPlane,
+)
+from realtime_fraud_detection_tpu.feedback.drill import (  # noqa: F401
+    run_feedback_drill,
+)

@@ -330,6 +330,47 @@ class MetricsCollector:
         # counters by deltas (keeps the _total series honest counters —
         # rate()/increase() and promtool lint stay valid)
         self._host_cache_seen: Dict[Tuple[str, str], float] = {}
+        # continuous-learning plane (feedback/): prequential quality under
+        # live labels, label-join health, and the retrain/gate/promotion
+        # audit counters — mirrored from FeedbackPlane.snapshot() by
+        # sync_feedback at exposition time, same registry, same exposition
+        self.preq_auc = r.gauge(
+            "prequential_auc",
+            "Streaming test-then-train AUC over matched labels",
+            ("window",))
+        self.preq_precision = r.gauge(
+            "prequential_precision",
+            "Prequential precision at the pinned operating threshold",
+            ("window",))
+        self.preq_recall = r.gauge(
+            "prequential_recall",
+            "Prequential recall at the pinned operating threshold",
+            ("window",))
+        self.preq_calibration = r.gauge(
+            "prequential_calibration_error",
+            "Expected calibration error over the sliding label window")
+        self.feedback_labels = r.counter(
+            "feedback_labels_total",
+            "Label-join outcomes (matched / expired_unlabeled / "
+            "orphan_labels / duplicate_labels)", ("outcome",))
+        self.feedback_label_lag = r.gauge(
+            "feedback_label_lag_seconds",
+            "Mean prediction-to-label delay over matched labels")
+        self.feedback_buffer = r.gauge(
+            "feedback_buffer_examples",
+            "Labeled-example buffer occupancy", ("klass",))
+        self.feedback_triggers = r.counter(
+            "feedback_retrain_triggers_total",
+            "Retrain triggers fired by the policy", ("reason",))
+        self.feedback_gate = r.counter(
+            "feedback_gate_verdicts_total",
+            "Promotion-gate verdicts on retrained candidates", ("verdict",))
+        self.feedback_promotions = r.counter(
+            "feedback_promotions_total",
+            "Candidates promoted into the serving blend")
+        # last-seen totals for the feedback counter mirrors (same honest-
+        # counter delta scheme as the host-assembly caches above)
+        self._feedback_seen: Dict[Tuple[str, str], float] = {}
 
     def sync_host_stats(self, host_stats: Mapping[str, Any]) -> None:
         """Mirror ``FraudScorer.host_stats()`` into the Prometheus series.
@@ -353,6 +394,53 @@ class MetricsCollector:
                 self.host_stage_ms.set(float(st.get(stat, 0.0)),
                                        stage=stage,
                                        stat=stat.replace("_ms", ""))
+
+    def sync_feedback(self, snapshot: Mapping[str, Any]) -> None:
+        """Mirror a ``FeedbackPlane.snapshot()`` into the Prometheus
+        series. Called at exposition time (cheap gauge sets); cumulative
+        plane counters mirror as counter deltas against last-seen values
+        (never a negative increment), matching sync_host_stats."""
+        preq = snapshot.get("prequential") or {}
+        for window in ("sliding", "fading"):
+            w = preq.get(window) or {}
+            for key, gauge in (("auc", self.preq_auc),
+                               ("precision", self.preq_precision),
+                               ("recall", self.preq_recall)):
+                v = w.get(key)
+                if v is not None and math.isfinite(float(v)):
+                    gauge.set(float(v), window=window)
+        ce = (preq.get("sliding") or {}).get("calibration_error")
+        if ce is not None and math.isfinite(float(ce)):
+            self.preq_calibration.set(float(ce))
+        self.feedback_label_lag.set(float(preq.get("mean_label_lag_s", 0.0)))
+        buf = snapshot.get("buffer") or {}
+        self.feedback_buffer.set(float(buf.get("positives", 0)),
+                                 klass="positive")
+        self.feedback_buffer.set(float(buf.get("negatives", 0)),
+                                 klass="negative")
+
+        def _mirror(counter, group: str, key: str, total: float,
+                    **labels: str) -> None:
+            seen_key = (group, key)
+            delta = float(total) - self._feedback_seen.get(seen_key, 0.0)
+            if delta > 0:
+                counter.inc(delta, **labels)
+            self._feedback_seen[seen_key] = float(total)
+
+        join = snapshot.get("label_join") or {}
+        for outcome in ("matched", "expired_unlabeled", "orphan_labels",
+                        "duplicate_labels"):
+            _mirror(self.feedback_labels, "join", outcome,
+                    join.get(outcome, 0), outcome=outcome)
+        policy = snapshot.get("policy") or {}
+        _mirror(self.feedback_gate, "gate", "pass",
+                policy.get("gate_pass", 0), verdict="pass")
+        _mirror(self.feedback_gate, "gate", "fail",
+                policy.get("gate_fail", 0), verdict="fail")
+        _mirror(self.feedback_promotions, "promotions", "total",
+                policy.get("promotions", 0))
+        _mirror(self.feedback_triggers, "triggers", "total",
+                policy.get("triggers", 0), reason="any")
 
     # ------------------------------------------------------------- recording
     def record_prediction(self, decision: str, fraud_score: float,

@@ -74,6 +74,23 @@ class ServingApp:
         from realtime_fraud_detection_tpu.qos import QosPlane
 
         self.qos = QosPlane(self.config.qos, metrics=self.metrics)
+        # continuous-learning plane (feedback/): always constructed so
+        # /labels and /quality/live work out of the box; the join /
+        # prequential / retrain machinery only runs when
+        # config.feedback.enabled. Shares this app's drift monitor and
+        # MetricsCollector; promotion goes through THIS app's score lock —
+        # the same recipe /reload-models applies.
+        from realtime_fraud_detection_tpu.feedback import FeedbackPlane
+        from realtime_fraud_detection_tpu.feedback.plane import (
+            promote_candidate,
+        )
+
+        self.feedback = FeedbackPlane(
+            self.config.feedback, scorer=self.scorer, config=self.config,
+            metrics=self.metrics, drift_monitor=self.drift,
+            promote_fn=lambda cand: promote_candidate(
+                self.scorer, self.config, cand, lock=self._score_lock))
+        self._feedback_reacting = False
         self.batcher = RequestMicrobatcher(
             self._score_batch_sync,
             max_batch=sc.microbatch_max_size,
@@ -185,7 +202,11 @@ class ServingApp:
         # (no device batch happened)
         if fresh:
             self.metrics.record_batch(len(fresh), dt)
-        if self.config.monitoring.enable_drift_detection and pending is not None:
+        if self.config.monitoring.enable_drift_detection \
+                and pending is not None \
+                and not self.config.feedback.enabled:
+            # with the feedback plane enabled, on_predictions below feeds
+            # the same shared drift monitor — don't double-count the batch
             with self._score_lock:
                 self.drift.update(pending.features)
         # experiments and per-prediction metrics run on FRESH results only:
@@ -206,6 +227,18 @@ class ServingApp:
             with self._score_lock:
                 for r in fresh:
                     cache.put(r["transaction_id"], r)
+        if self.config.feedback.enabled and fresh:
+            # continuous-learning plane: register exactly what this batch
+            # serves (post-experiment scores) with the label join + drift
+            # monitor, then run the cheap trigger check; the expensive
+            # retrain runs on a worker thread (_maybe_react)
+            with self._score_lock:
+                self.feedback.on_predictions(
+                    to_score, fresh,
+                    features=(pending.features if pending is not None
+                              else None))
+                self.feedback.check_trigger()
+            self._maybe_react()
         # reassemble in request order
         if cached:
             results, it_fresh = [], iter(fresh)
@@ -250,6 +283,31 @@ class ServingApp:
                     res["fraud_score"] > alert_t,
                     bool(actual) if actual is not None else None)
 
+    def _maybe_react(self) -> None:
+        """Kick the plane's retrain->gate->promote on a worker thread when
+        a trigger is pending (never on the scoring path). One reaction in
+        flight at a time; the promotion itself happens under the score
+        lock inside promote_fn — the /reload-models recipe."""
+        if self.feedback.pending_trigger is None or self._feedback_reacting:
+            return
+        self._feedback_reacting = True
+
+        def _run() -> None:
+            try:
+                # O(n) shallow row snapshot under the ingest lock; the
+                # expensive sort + stack and the training itself run
+                # lock-free — the retrain must never block scoring
+                with self._score_lock:
+                    rows = self.feedback.buffer.snapshot_rows()
+                arrays = self.feedback.buffer.arrays_from(
+                    rows, self.feedback.buffer.store_history)
+                self.feedback.react(arrays=arrays)
+            finally:
+                self._feedback_reacting = False
+
+        threading.Thread(target=_run, name="feedback-retrain",
+                         daemon=True).start()
+
     # ---------------------------------------------------------------- routes
     def _register_routes(self) -> None:
         r = self.http.route
@@ -265,6 +323,8 @@ class ServingApp:
         r("GET", "/experiments", self._experiment_results)
         r("GET", "/qos", self._qos_status)
         r("POST", "/qos", self._qos_configure)
+        r("POST", "/labels", self._ingest_labels)
+        r("GET", "/quality/live", self._quality_live)
 
     def _admit(self, n: int) -> None:
         limit = self.config.serving.max_concurrent_predictions
@@ -376,9 +436,14 @@ class ServingApp:
         return 200, payload
 
     async def _metrics_prometheus(self, body, query) -> Tuple[int, Any]:
-        # mirror the scorer's host-assembly spans + cache counters into
-        # the registry at scrape time (cheap gauge sets)
+        # mirror the scorer's host-assembly spans + cache counters and the
+        # feedback plane's prequential/label/promotion series into the
+        # registry at scrape time (cheap gauge sets + counter deltas)
         self.metrics.sync_host_stats(self.scorer.host_stats())
+        if self.config.feedback.enabled:
+            with self._score_lock:
+                snap = self.feedback.snapshot()
+            self.metrics.sync_feedback(snap)
         return 200, self.metrics.render_prometheus()
 
     async def _model_info(self, body, query) -> Tuple[int, Any]:
@@ -427,6 +492,30 @@ class ServingApp:
                     except (TypeError, ValueError):
                         raise HttpError(422, f"step must be an integer, "
                                              f"got {step!r}")
+                if blend_requested:
+                    # refuse to combine a checkpoint and a quality artifact
+                    # that record DIFFERENT text-encoder architectures —
+                    # the blend was measured with one model, the params are
+                    # another; serving that pair silently mixes quality
+                    # claims (VERDICT Weak #5). Checked BEFORE the restore
+                    # so a refusal leaves the live deployment untouched;
+                    # {"allow_arch_mismatch": true} overrides explicitly.
+                    art_tm = Config.load_artifact_text_model(
+                        str(body["quality_artifact"]))
+                    try:
+                        ck_meta = (CheckpointManager(body["checkpoint_dir"])
+                                   .manifest(step).get("metadata") or {})
+                    except FileNotFoundError as e:
+                        raise HttpError(404, str(e))
+                    ck_tm = ck_meta.get("text_model")
+                    if (art_tm is not None and ck_tm is not None
+                            and dict(art_tm) != dict(ck_tm)
+                            and not body.get("allow_arch_mismatch")):
+                        raise HttpError(
+                            409, f"text-encoder architecture mismatch: "
+                                 f"artifact records {art_tm}, checkpoint "
+                                 f"records {ck_tm}; pass "
+                                 f"allow_arch_mismatch to combine anyway")
 
                 def _restore():
                     # one shared recipe (checkpoint.restore_into_scorer):
@@ -516,6 +605,43 @@ class ServingApp:
                 self.scorer.set_degradation(None)
         return 200, {"status": "configured", "applied": applied,
                      "qos": self.qos.snapshot()}
+
+    async def _ingest_labels(self, body, query) -> Tuple[int, Any]:
+        """Ingest delayed ground-truth label events (the labels-topic
+        seam over HTTP). Body: one event dict or a list of them; each
+        needs ``transaction_id``, ``is_fraud`` and (optionally)
+        ``label_ts``. Labels are joined to emitted predictions, feed the
+        prequential metrics + labeled buffer, and can trigger a
+        retrain."""
+        if not self.config.feedback.enabled:
+            raise HttpError(409, "feedback plane disabled "
+                                 "(config.feedback.enabled)")
+        events = body if isinstance(body, list) else [body]
+        cleaned = []
+        for ev in events:
+            if not isinstance(ev, dict) or not ev.get("transaction_id") \
+                    or "is_fraud" not in ev:
+                raise HttpError(
+                    422, "each label event needs transaction_id + is_fraud")
+            ev = dict(ev)
+            ev.setdefault("label_ts", time.time())
+            cleaned.append(ev)
+        with self._score_lock:
+            matched = self.feedback.on_labels(cleaned)
+            self.feedback.check_trigger()
+        self._maybe_react()
+        return 200, {"ingested": len(cleaned), "matched": matched,
+                     "join": self.feedback.join.stats()}
+
+    async def _quality_live(self, body, query) -> Tuple[int, Any]:
+        """Live model quality under delayed ground truth: prequential
+        sliding/fading AUC + precision/recall at the pinned operating
+        point, calibration error, per-branch drop-one attribution,
+        label-join health, buffer occupancy, and the retrain/gate/
+        promotion audit tail. Snapshotted under the score lock — the
+        executor thread mutates the plane's windows under the same lock."""
+        with self._score_lock:
+            return 200, self.feedback.snapshot()
 
     async def _drift(self, body, query) -> Tuple[int, Any]:
         rep = self.drift.report()

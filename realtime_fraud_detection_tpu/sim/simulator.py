@@ -191,6 +191,10 @@ class TransactionGenerator:
         self.clock = start_time or datetime(2026, 1, 5, 8, 0, tzinfo=timezone.utc)
         self.tps = tps
         self._txn_counter = 0
+        # drifted fraud pattern (inject_drift): a novel modus operandi the
+        # incumbent models never trained on — 0.0 = off (default)
+        self._drift_rate = 0.0
+        self._drift_merchants: np.ndarray | None = None
 
     # ------------------------------------------------------------------ dicts
     def generate_batch(self, n: int) -> List[Dict[str, Any]]:
@@ -273,7 +277,73 @@ class TransactionGenerator:
         else:
             txn["fraud_score"] = float(rng.uniform(0.0, 0.3))
             self.patterns.record_location(txn["user_id"], geo)
+        if self._drift_rate > 0.0 and rng.random() < self._drift_rate:
+            txn = self._apply_drifted_pattern(txn)
         return txn
+
+    # ------------------------------------------------------------ drift
+    def inject_drift(self, rate: float = 0.05) -> None:
+        """Turn on the drifted fraud pattern: a ``rate`` fraction of the
+        stream becomes a novel modus operandi (``fraud_type
+        'drifted_pattern'``) that an incumbent model has never seen —
+        benign-looking prior score, mid-range amounts, but a learnable
+        signature (night-hour + crypto rail + a small complicit merchant
+        ring). Drives the continuous-learning drill (feedback/drill.py):
+        a pre-drift model ranks these like legit traffic, so prequential
+        AUC dips until a retrain on labeled drifted examples recovers it.
+        """
+        if not 0.0 <= rate <= 1.0:
+            raise ValueError(f"drift rate must be in [0, 1], got {rate}")
+        self._drift_rate = float(rate)
+        if self._drift_merchants is None:
+            # the complicit ring is one coherent merchant CATEGORY
+            # (electronics): ring membership is a single categorical
+            # feature a retrained tree can split on, while the incumbent —
+            # which saw electronics as a benign category — has no reason to
+            ring = self.merchants.ids[self.merchants.category
+                                      == "electronics"]
+            if len(ring) == 0:
+                ring = self.merchants.ids[:max(1, self.merchants.n // 10)]
+            self._drift_merchants = ring
+
+    def clear_drift(self) -> None:
+        self._drift_rate = 0.0
+
+    def _apply_drifted_pattern(self, txn: Dict[str, Any]) -> Dict[str, Any]:
+        rng = self.rng
+        txn["is_fraud"] = True
+        txn["fraud_type"] = "drifted_pattern"
+        # the signature is deliberately IN-DISTRIBUTION per feature — the
+        # user's own ordinary amount, a mainstream payment rail, a benign
+        # prior score, ordinary geo/hour — so neither the leaky prior
+        # feature, amount-vs-user-average splits, nor an anomaly detector
+        # gets a free win; the signal lives only in the CONJUNCTION
+        # (electronics-ring merchant x digital-wallet rail), which a model
+        # must be retrained on drifted labels to rank
+        txn["merchant_id"] = str(
+            self._drift_merchants[int(rng.integers(
+                0, len(self._drift_merchants)))])
+        txn["payment_method"] = "digital_wallet"
+        txn["fraud_score"] = float(rng.uniform(0.0, 0.3))
+        txn["fraud_reason"] = "drifted pattern (novel MO, unseen in training)"
+        return txn
+
+    # ------------------------------------------------------------ labels
+    def label_events(self, txns: Sequence[Dict[str, Any]],
+                     event_ts: Sequence[float] | None = None,
+                     delay_scale: float = 1.0) -> List[Dict[str, Any]]:
+        """Delayed ground-truth label events for already-generated
+        transactions (the labels-topic producer role): chargeback-style
+        delays drawn from this generator's rng (deterministic replay),
+        sorted by ``label_ts``. See feedback/labels.make_label_events."""
+        from realtime_fraud_detection_tpu.feedback.labels import (
+            make_label_events,
+        )
+
+        return make_label_events(list(txns), self.rng,
+                                 event_ts=(list(event_ts)
+                                           if event_ts is not None else None),
+                                 delay_scale=delay_scale)
 
     def _random_ip(self) -> str:
         rng = self.rng

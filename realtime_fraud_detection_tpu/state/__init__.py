@@ -15,6 +15,9 @@ from realtime_fraud_detection_tpu.state.shared import (  # noqa: F401
     SharedTransactionCache,
     SharedVelocityStore,
 )
+from realtime_fraud_detection_tpu.state.labeled import (  # noqa: F401
+    LabeledExampleBuffer,
+)
 from realtime_fraud_detection_tpu.state.history import (  # noqa: F401
     UserHistoryStore,
     EntityGraphStore,

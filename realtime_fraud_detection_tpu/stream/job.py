@@ -82,6 +82,12 @@ class JobConfig:
     # backlog signal (consumer lag + pipelined in-flight records). None or
     # enabled=False = the plane is off, behavior unchanged.
     qos: Optional[Any] = None            # utils.config.QosSettings
+    # continuous-learning plane (feedback/): a FeedbackPlane instance the
+    # job feeds after every completed batch (emitted predictions +
+    # assembled feature rows into the label join / drift monitor) and
+    # whose labels topic it drains in the run loops. None = off.
+    feedback: Optional[Any] = None       # feedback.FeedbackPlane
+    labels_topic: str = T.LABELS
     # topic names (reference JobConfig.java topic parameters); defaults are
     # the §2.5 contract (stream/topics.py) — overridable per deployment,
     # e.g. the reference's test-transactions topic for shadow traffic
@@ -159,6 +165,15 @@ class StreamJob:
         self.analytics = (
             WindowedAnalytics(broker) if self.config.enable_analytics else None
         )
+        # continuous-learning plane: its own consumer group on the labels
+        # topic (labels are a separate stream with its own offsets — a
+        # replayed label batch must not disturb transaction offsets)
+        self.feedback = self.config.feedback
+        self._labels_consumer = None
+        if self.feedback is not None:
+            self._labels_consumer = broker.consumer(
+                [self.config.labels_topic],
+                f"{self.config.group_id}-labels")
         # overlapped host assembly: scorer.dispatch moves to a background
         # stage thread; this thread keeps admission/dedupe/commit order
         self._stage = None
@@ -349,8 +364,21 @@ class StreamJob:
             invalid_results = self._emit_invalid(ctx)
             self._emit_shed(ctx)
             self._emit_cached_dups(ctx)
-            return invalid_results + self._fan_out(
+            out = invalid_results + self._fan_out(
                 ctx, fresh, results, feats, scored_ok, now)
+            if self.feedback is not None and scored_ok:
+                # feed the label join with exactly what was emitted, plus
+                # the assembled feature rows (the retrain corpus), then
+                # drain any due labels and run the cheap policy check —
+                # the expensive retrain stays with the caller (react)
+                self.feedback.on_predictions(
+                    [r.value for r in fresh], results,
+                    features=feats[:len(fresh)] if feats is not None
+                    else None,
+                    now=t_done)
+                self.drain_labels()
+                self.feedback.check_trigger(now=t_done)
+            return out
         finally:
             # ALWAYS release, even when fan-out raises mid-way (broker down):
             # a leaked id makes the replayed record look like an in-flight
@@ -530,6 +558,21 @@ class StreamJob:
             "timestamp": txn.get("timestamp"),
         }
 
+    def drain_labels(self, max_records: int = 10_000) -> int:
+        """Poll the labels topic into the feedback plane (no-op without
+        one). Label offsets commit immediately after ingestion: the join +
+        prequential state is process-local anyway, and a replayed label is
+        deduplicated by the join."""
+        if self.feedback is None or self._labels_consumer is None:
+            return 0
+        recs = self._labels_consumer.poll(max_records)
+        if not recs:
+            return 0
+        matched = self.feedback.on_labels(
+            [r.value for r in recs if isinstance(r.value, dict)])
+        self._labels_consumer.commit()
+        return matched
+
     # ------------------------------------------------------------------ run
     def run_until_drained(self, max_batches: int = 10_000,
                           now: Optional[float] = None) -> int:
@@ -553,8 +596,14 @@ class StreamJob:
             in_flight.append(self.dispatch_batch(batch, now=now))
             while len(in_flight) >= depth:
                 self.complete_batch(in_flight.popleft())
+            if self.feedback is not None \
+                    and self.feedback.pending_trigger is not None:
+                # retrain between batches (the job is a batch process; the
+                # serving app instead hands this to a worker thread)
+                self.feedback.react(now=now)
         while in_flight:
             self.complete_batch(in_flight.popleft())
+        self.drain_labels()
         return self.counters["scored"] - start_scored
 
     def close(self) -> None:
@@ -576,6 +625,10 @@ class StreamJob:
                 in_flight.append(self.dispatch_batch(batch))
             if in_flight and (len(in_flight) >= depth or not batch):
                 self.complete_batch(in_flight.popleft())
+            if self.feedback is not None \
+                    and self.feedback.pending_trigger is not None:
+                self.feedback.react()
         while in_flight:
             self.complete_batch(in_flight.popleft())
+        self.drain_labels()
         return self.counters["scored"] - start

@@ -1,11 +1,13 @@
 """Topic contract: names, partition counts, retention/compaction classes.
 
 Mirror of the reference's Kafka topic contract (create-topics.sh:60-151):
-29 topics — 27 regular + 2 compacted profile topics — across core /
-behavioral / alert / stream-processing / analytics / test groups, RF=3
-minISR=2 lz4 in the real deployment. The in-memory broker honors the same
-names and partition counts so partition-keyed ordering semantics match a
-real Kafka deployment.
+29 reference topics — 27 regular + 2 compacted profile topics — across
+core / behavioral / alert / stream-processing / analytics / test groups,
+RF=3 minISR=2 lz4 in the real deployment, plus this framework's one
+extension: ``transaction-labels``, the delayed ground-truth stream that
+closes the continuous-learning loop (feedback/). The in-memory broker
+honors the same names and partition counts so partition-keyed ordering
+semantics match a real Kafka deployment.
 """
 
 from __future__ import annotations
@@ -59,6 +61,10 @@ TOPIC_SPECS: tuple[TopicSpec, ...] = (
     TopicSpec("test-transactions", 4),
     TopicSpec("model-experiments", 2),
     TopicSpec("feature-experiments", 2),
+    # framework extension (no reference analog): delayed ground-truth
+    # labels — chargeback outcomes keyed by user like the transactions
+    # they label, consumed by the continuous-learning plane (feedback/)
+    TopicSpec("transaction-labels", 12),
 )
 
 TOPIC_BY_NAME = {t.name: t for t in TOPIC_SPECS}
@@ -69,3 +75,4 @@ FEATURES = "transaction-features"
 PREDICTIONS = "fraud-predictions"
 DECISIONS = "fraud-decisions"
 ALERTS = "fraud-alerts"
+LABELS = "transaction-labels"

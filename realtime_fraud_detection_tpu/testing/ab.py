@@ -201,6 +201,17 @@ class ABTestManager:
         from realtime_fraud_detection_tpu.utils.config import Config
 
         weights = Config.load_selected_blend_weights(artifact_path)
+        strategy = Config.load_selected_blend_strategy(artifact_path)
+        if strategy not in (None, "weighted_average"):
+            # host-side variant evaluation recombines the returned branch
+            # predictions as a weighted average; a stacking/voting artifact
+            # measured a DIFFERENT combine, so the canary arm would not be
+            # serving what the artifact promises — deploy such artifacts
+            # via /reload-models (the device combine honors the strategy)
+            raise ValueError(
+                f"artifact blend uses strategy {strategy!r}, which host-"
+                f"side re-weighting cannot emulate; canary it via "
+                f"/reload-models instead")
         unknown = [n for n in weights if n not in MODEL_NAMES]
         if unknown:
             raise ValueError(

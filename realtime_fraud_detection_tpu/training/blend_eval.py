@@ -96,6 +96,17 @@ class BlendEvalConfig:
     weight_scales: Tuple[float, ...] = (1.0, 0.5, 0.25, 0.125)
     precision_target: float = 0.94
     bootstrap: int = 1000
+    # combine-strategy selection: after weight admission, the stacked
+    # combiner (ensemble/combine.py STACKING — shipped in the device
+    # program but never exercised by this protocol before) competes with
+    # weighted_average on validation; the winner is recorded in
+    # selected_blend.strategy and deployed by apply_quality_artifact
+    try_stacking: bool = True
+    # saving into a checkpoint_dir whose latest step records a DIFFERENT
+    # text-encoder architecture is refused unless explicitly allowed —
+    # mixing architectures across steps makes "restore latest + apply
+    # artifact" quietly incoherent (VERDICT Weak #5)
+    allow_arch_mismatch: bool = False
 
 
 def _auc(y: np.ndarray, s: np.ndarray) -> float:
@@ -285,37 +296,21 @@ def _train_branches(
     return scores, calibration, trained
 
 
-def _blend_fn(weights_by_name: Dict[str, float]):
-    """Serving-parity blend: combine_predictions over the branch set.
-
-    Returns a callable scores_by_branch -> fraud probabilities, running the
-    SAME jitted combine the fused device program uses (weighted average
-    over valid branches, weights renormalized).
-    """
-    import jax.numpy as jnp
-
+def _blend_fn(weights_by_name: Dict[str, float],
+              strategy: str = "weighted_average"):
+    """Serving-parity blend: the shared ``blend_branch_scores`` recipe
+    (ensemble/combine.py — also the continuous-learning gate's combine),
+    curried over this protocol's weights + strategy. Returns a callable
+    scores_by_branch -> fraud probabilities running the SAME jitted
+    combine the fused device program uses — weighted average or the
+    stacked combiner."""
     from realtime_fraud_detection_tpu.ensemble.combine import (
-        EnsembleParams,
-        combine_predictions,
+        blend_branch_scores,
     )
-    from realtime_fraud_detection_tpu.scoring import MODEL_NAMES
-    from realtime_fraud_detection_tpu.utils.config import Config
-
-    base = EnsembleParams.from_config(Config(), list(MODEL_NAMES))
-    w = jnp.asarray([weights_by_name.get(n, 0.0) for n in MODEL_NAMES],
-                    jnp.float32)
-    params = base.replace(weights=w)
-    valid = np.asarray([weights_by_name.get(n, 0.0) > 0.0
-                        for n in MODEL_NAMES])
 
     def blend(scores_by_branch: Dict[str, np.ndarray]) -> np.ndarray:
-        n = len(next(iter(scores_by_branch.values())))
-        preds = np.stack(
-            [scores_by_branch.get(name, np.zeros(n, np.float32))
-             for name in MODEL_NAMES], axis=1)
-        out = combine_predictions(jnp.asarray(preds), jnp.asarray(valid),
-                                  params, with_confidences=False)
-        return np.asarray(out["fraud_probability"])
+        return blend_branch_scores(scores_by_branch, weights_by_name,
+                                   strategy)
 
     return blend
 
@@ -391,7 +386,22 @@ def run_blend_eval(cfg: Optional[BlendEvalConfig] = None,
         if accepted:
             weights, cur_val_auc = trial, a
 
-    blend = _blend_fn(weights)
+    # ------------- combine-strategy selection (decided on VALIDATION):
+    # the stacked combiner competes with weighted_average over the
+    # admitted branch set — same weights, same jitted device combine
+    strategy = "weighted_average"
+    strategy_selection = {
+        "weighted_average": round(cur_val_auc, 4),
+    }
+    if cfg.try_stacking:
+        stack_val = _auc(y_va, _blend_fn(weights, "stacking")(scores["val"]))
+        strategy_selection["stacking"] = round(stack_val, 4)
+        if not np.isnan(stack_val) and stack_val > cur_val_auc:
+            strategy, cur_val_auc = "stacking", stack_val
+    strategy_selection["selected"] = strategy
+    log(f"combine strategy (val): {strategy_selection}")
+
+    blend = _blend_fn(weights, strategy)
     blend_te = blend(scores["test"])
     blend_va = blend(scores["val"])
     baseline_te = _blend_fn(
@@ -417,7 +427,8 @@ def run_blend_eval(cfg: Optional[BlendEvalConfig] = None,
             break
         rest = {k: v for k, v in weights.items() if k != name}
         ablation[name] = round(
-            test_auc - _auc(y_te, _blend_fn(rest)(scores["test"])), 4)
+            test_auc - _auc(y_te, _blend_fn(rest, strategy)(
+                scores["test"])), 4)
 
     # ---------------- operating points (threshold chosen on VALIDATION)
     pos_va = y_va > 0.5
@@ -442,19 +453,37 @@ def run_blend_eval(cfg: Optional[BlendEvalConfig] = None,
         from realtime_fraud_detection_tpu.checkpoint import CheckpointManager
         from realtime_fraud_detection_tpu.scoring import ScoringModels
 
+        mgr = CheckpointManager(checkpoint_dir)
+        latest = mgr.latest_step()
+        if latest is not None and not cfg.allow_arch_mismatch:
+            prev_tm = (mgr.manifest(latest).get("metadata")
+                       or {}).get("text_model")
+            this_tm = dataclasses.asdict(cfg.bert)
+            if prev_tm is not None and dict(prev_tm) != this_tm:
+                # a dir mixing text architectures across steps makes
+                # "restore latest" + "apply artifact" quietly incoherent —
+                # refuse unless the caller explicitly allows it
+                raise ValueError(
+                    f"checkpoint dir {checkpoint_dir} step {latest} records "
+                    f"text_model {prev_tm}, but this protocol runs "
+                    f"{this_tm}; use a fresh directory or set "
+                    f"allow_arch_mismatch")
+
         models = ScoringModels(
             trees=trained["trees"], iforest=trained["iforest"],
             lstm=trained["lstm"], gnn=trained["gnn"], bert=trained["bert"])
-        CheckpointManager(checkpoint_dir).save(
-            0, params=models,
+        step = 0 if latest is None else latest + 1
+        mgr.save(
+            step, params=models,
             metadata={
                 "source": "blend_eval",
                 "text_model": dataclasses.asdict(cfg.bert),
                 "text_len": cfg.text_len,
                 "tokenizer": cfg.tokenizer,
                 "selected_blend": sorted(weights),
+                "selected_strategy": strategy,
             })
-        checkpoint_info = {"dir": str(checkpoint_dir), "step": 0}
+        checkpoint_info = {"dir": str(checkpoint_dir), "step": step}
         log(f"saved trained+calibrated branches to {checkpoint_dir}")
 
     return {
@@ -476,10 +505,12 @@ def run_blend_eval(cfg: Optional[BlendEvalConfig] = None,
         "checkpoint": checkpoint_info,
         "branch_auc": branch_auc,
         "admission": admission,
+        "strategy_selection": strategy_selection,
         "selected_blend": {
             "branches": sorted(weights),
             "weights": {k: round(v, 4) for k, v in sorted(weights.items())},
             "n_branches": len(weights),
+            "strategy": strategy,
         },
         "test": {
             "blend_auc": round(test_auc, 4),

@@ -215,6 +215,78 @@ class QosSettings:
 
 
 @dataclass
+class FeedbackSettings:
+    """Continuous-learning plane knobs (feedback/): label join, prequential
+    evaluation, retrain policy, promotion gate. Disabled by default — the
+    plane is opt-in per deployment (``serve``/``run-job --feedback``,
+    config/JSON overlay). All knobs are host state: changing them never
+    recompiles anything (a promoted blend that switches the combine
+    STRATEGY recompiles once, like any strategy change).
+    """
+
+    enabled: bool = False
+    # label-join windowing: how long an unlabeled prediction waits for its
+    # chargeback before expiring, and the per-stream out-of-orderness
+    label_horizon_s: float = 90 * 86_400.0
+    label_ooo_s: float = 60.0
+    pred_ooo_s: float = 5.0
+    # hard cap on predictions waiting for a label (the watermark horizon
+    # can't evict while the labels topic is silent; memory must not grow
+    # with stream length)
+    join_max_pending: int = 100_000
+    # synthetic label emission (sim): compresses the chargeback delay
+    # distribution (1.0 = realistic days; drills use tiny values)
+    label_delay_scale: float = 1.0
+    # labeled-example buffer (state/labeled.py)
+    buffer_size: int = 50_000
+    buffer_store_history: bool = False
+    # prequential evaluation
+    sliding_window: int = 2_000
+    fading_gamma: float = 0.999
+    operating_threshold: float = 0.5
+    # retrain policy
+    auc_drop: float = 0.08
+    auc_floor: float = 0.0
+    min_labels: int = 300
+    cooldown_s: float = 600.0
+    use_drift_trigger: bool = True
+    # candidate training
+    retrain_trees: int = 48
+    retrain_depth: int = 5
+    retrain_iforest_trees: int = 60
+    retrain_neural: bool = False
+    # promotion gate
+    gate_holdout_frac: float = 0.2
+    gate_select_frac: float = 0.2
+    gate_min_positives: int = 12
+    gate_auc_margin: float = 0.0
+    gate_recall_tolerance: float = 0.02
+
+    def validate(self) -> None:
+        if not 0.0 < self.fading_gamma < 1.0:
+            raise ValueError(
+                f"feedback.fading_gamma must be in (0, 1), got "
+                f"{self.fading_gamma}")
+        if self.sliding_window < 10 or self.buffer_size < 10:
+            raise ValueError(
+                "feedback.sliding_window and buffer_size must be >= 10")
+        if not (0.0 < self.gate_holdout_frac < 1.0
+                and 0.0 < self.gate_select_frac < 1.0
+                and self.gate_holdout_frac + self.gate_select_frac < 0.9):
+            # the gate must always keep a real training majority: a split
+            # that eats the training segment would gate candidates trained
+            # on nothing
+            raise ValueError(
+                f"feedback gate fractions must satisfy 0 < holdout, select "
+                f"and holdout + select < 0.9, got "
+                f"holdout={self.gate_holdout_frac} "
+                f"select={self.gate_select_frac}")
+        if self.label_horizon_s <= 0 or self.label_delay_scale <= 0:
+            raise ValueError(
+                "feedback.label_horizon_s and label_delay_scale must be > 0")
+
+
+@dataclass
 class StateConfig:
     """Windowed state store settings (RedisService.java key TTLs)."""
 
@@ -319,6 +391,7 @@ class Config:
     sim: SimConfig = field(default_factory=SimConfig)
     monitoring: MonitoringConfig = field(default_factory=MonitoringConfig)
     qos: QosSettings = field(default_factory=QosSettings)
+    feedback: FeedbackSettings = field(default_factory=FeedbackSettings)
 
     def __post_init__(self) -> None:
         self._apply_env()
@@ -390,6 +463,39 @@ class Config:
                 f"quality-eval artifact?")
         return {str(n): float(w) for n, w in weights.items()}
 
+    @staticmethod
+    def load_selected_blend_strategy(artifact_path: str) -> str | None:
+        """The artifact's measured combine strategy (selected_blend.
+        strategy), or None for pre-strategy artifacts (which were all
+        measured under weighted_average). Unknown names raise — a typo'd
+        strategy must not silently serve the default."""
+        with open(artifact_path) as f:
+            artifact = json.load(f)
+        blend = (artifact.get("selected_blend")
+                 if isinstance(artifact, dict) else None)
+        strategy = blend.get("strategy") if isinstance(blend, dict) else None
+        if strategy is None:
+            return None
+        if strategy not in VALID_STRATEGIES:
+            raise ValueError(
+                f"{artifact_path} selected_blend.strategy {strategy!r} not "
+                f"one of {VALID_STRATEGIES}")
+        return str(strategy)
+
+    @staticmethod
+    def load_artifact_text_model(artifact_path: str) -> Dict[str, Any] | None:
+        """The artifact's recorded text-encoder architecture
+        (protocol.text_model: layers/width/vocab), or None when absent.
+        The one place the key is read — serve/--quality-artifact and
+        /reload-models both use it to refuse mixing artifacts and
+        checkpoints from different architectures."""
+        with open(artifact_path) as f:
+            artifact = json.load(f)
+        proto = (artifact.get("protocol")
+                 if isinstance(artifact, dict) else None)
+        tm = proto.get("text_model") if isinstance(proto, dict) else None
+        return dict(tm) if isinstance(tm, dict) else None
+
     def apply_quality_artifact(self, artifact_path: str) -> Dict[str, float]:
         """Deploy a measured blend: set enabled models + weights from a
         quality-eval artifact (`rtfd quality-eval` / QUALITY_r*.json).
@@ -400,9 +506,14 @@ class Config:
         table, so the scorer's validity mask and the device combine's
         weights are exactly what the protocol measured. Branches outside
         the blend stay configured but disabled (hot-enable later via
-        /reload-models + enable_model without a recompile). Returns the
-        applied weights."""
+        /reload-models + enable_model without a recompile). When the
+        artifact records a measured combine strategy (selected_blend.
+        strategy — e.g. the stacked combiner), that deploys too (NOTE: a
+        strategy change is the one blend knob that recompiles the fused
+        program once, being a static argument). Returns the applied
+        weights."""
         weights = self.load_selected_blend_weights(artifact_path)
+        strategy = self.load_selected_blend_strategy(artifact_path)
         unknown = [n for n in weights if n not in self.models]
         if unknown:
             raise ValueError(
@@ -414,6 +525,8 @@ class Config:
                 mc.weight = float(weights[name])
             else:
                 mc.enabled = False
+        if strategy is not None:
+            self.ensemble.strategy = strategy
         return {n: float(w) for n, w in weights.items()}
 
     # -- serialization -----------------------------------------------------
@@ -453,6 +566,7 @@ class Config:
                 f"monitor={e.monitor_threshold} review={e.review_threshold} "
                 f"decline={e.decline_threshold}")
         self.qos.validate()
+        self.feedback.validate()
 
 
 def _merge_dataclass(obj: Any, data: Dict[str, Any]) -> None:

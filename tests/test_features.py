@@ -404,7 +404,11 @@ class TestIngestFuzz:
 
     @staticmethod
     def _strategies():
-        from hypothesis import strategies as st
+        import pytest
+
+        st = pytest.importorskip(
+            "hypothesis.strategies",
+            reason="hypothesis not installed in this image")
 
         scalar = st.one_of(
             st.none(), st.booleans(), st.integers(-10**12, 10**12),
@@ -430,7 +434,12 @@ class TestIngestFuzz:
         })
 
     def test_sanitize_then_encode_never_crashes(self):
-        from hypothesis import given, settings
+        import pytest
+
+        hypothesis = pytest.importorskip(
+            "hypothesis",
+            reason="hypothesis not installed in this image")
+        given, settings = hypothesis.given, hypothesis.settings
 
         from realtime_fraud_detection_tpu.features.schema import (
             encode_transactions,

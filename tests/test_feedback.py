@@ -1,0 +1,457 @@
+"""Continuous-learning plane (feedback/): prequential math pinned against
+offline references, label-join semantics, buffer/policy/gate units, the
+serving endpoints, and the closed-loop drill acceptance criteria."""
+
+import asyncio
+import contextlib
+import io
+import json
+import math
+
+import numpy as np
+import pytest
+
+from realtime_fraud_detection_tpu.feedback.labels import (
+    LabelJoin,
+    make_label_events,
+)
+from realtime_fraud_detection_tpu.feedback.policy import (
+    PromotionGate,
+    RetrainPolicy,
+)
+from realtime_fraud_detection_tpu.feedback.prequential import (
+    FadingAUC,
+    PrequentialEvaluator,
+    sliding_auc,
+    weighted_auc,
+)
+from realtime_fraud_detection_tpu.state.labeled import LabeledExampleBuffer
+
+
+# --------------------------------------------------------------- prequential
+class TestPrequentialMath:
+    def _event_sequence(self, n=1500, seed=0):
+        rng = np.random.default_rng(seed)
+        y = (rng.random(n) < 0.25).astype(float)
+        # heavy ties: scores quantized to 2 decimals, informative but noisy
+        s = np.round(np.clip(0.55 * y + 0.3 * rng.random(n), 0, 1), 2)
+        return y, s
+
+    def test_sliding_auc_matches_sklearn_on_same_event_sequence(self):
+        sk = pytest.importorskip("sklearn.metrics")
+        y, s = self._event_sequence()
+        window = 400
+        ev = PrequentialEvaluator(window=window, threshold=0.5)
+        for yi, si in zip(y, s):
+            ev.update(si, bool(yi))
+        yw, sw = y[-window:], s[-window:]
+        assert abs(ev.auc() - sk.roc_auc_score(yw, sw)) <= 1e-6
+        pr = ev.precision_recall()
+        flag = sw >= 0.5
+        assert abs(pr["precision"]
+                   - sk.precision_score(yw, flag)) <= 1e-6
+        assert abs(pr["recall"] - sk.recall_score(yw, flag)) <= 1e-6
+
+    def test_sliding_auc_ties_not_credited_in_argsort_order(self):
+        # a constant scorer must be exactly 0.5, not 1.0
+        y = np.array([0, 1, 0, 1, 1, 0], float)
+        s = np.full(6, 0.7)
+        assert sliding_auc(y, s) == pytest.approx(0.5)
+
+    def test_fading_auc_matches_numpy_double_sum_reference(self):
+        y, s = self._event_sequence(n=600, seed=3)
+        gamma = 0.98
+        f = FadingAUC(gamma=gamma, threshold=0.5)
+        for yi, si in zip(y, s):
+            f.update(si, bool(yi))
+        n = len(f)
+        yw, sw = y[-n:], s[-n:]
+        w = gamma ** np.arange(n - 1, -1, -1, dtype=float)
+        pos_idx = np.where(yw > 0.5)[0]
+        neg_idx = np.where(yw <= 0.5)[0]
+        num = 0.0
+        for i in pos_idx:           # the O(n^2) definition, verbatim
+            num += (w[i] * w[neg_idx] * (
+                (sw[i] > sw[neg_idx]) + 0.5 * (sw[i] == sw[neg_idx]))).sum()
+        ref = num / (w[pos_idx].sum() * w[neg_idx].sum())
+        assert abs(f.auc() - ref) <= 1e-9
+
+    def test_weighted_auc_single_class_is_nan(self):
+        assert math.isnan(weighted_auc(np.ones(5), np.arange(5.0),
+                                       np.ones(5)))
+
+    def test_calibration_error_reference(self):
+        ev = PrequentialEvaluator(window=100, calibration_bins=2)
+        # bin [0, .5): scores .2, fraud rate 0; bin [.5, 1]: .8 vs rate 0.5
+        for _ in range(2):
+            ev.update(0.2, False)
+            ev.update(0.8, True)
+            ev.update(0.8, False)
+        # ece = (2/6)*|.2-0| + (4/6)*|.8-.5|
+        assert ev.calibration_error() == pytest.approx(
+            (2 / 6) * 0.2 + (4 / 6) * 0.3)
+
+    def test_drop_one_attribution_flags_the_carrying_branch(self):
+        rng = np.random.default_rng(1)
+        ev = PrequentialEvaluator(window=600)
+        for _ in range(600):
+            y = rng.random() < 0.3
+            good = 0.7 * y + 0.2 * rng.random()
+            noise = rng.random()
+            served = 0.8 * good + 0.2 * noise
+            ev.update(served, bool(y),
+                      branch_preds={"good": good, "noise": noise})
+        attr = ev.drop_one_attribution({"good": 0.8, "noise": 0.2})
+        assert attr["good"] > 0.1          # dropping it hurts a lot
+        assert attr["noise"] < 0.05        # dropping noise barely matters
+
+
+# ---------------------------------------------------------------- label join
+class TestLabelJoin:
+    def test_match_and_lag(self):
+        j = LabelJoin(horizon_s=100, pred_ooo_s=1, label_ooo_s=1)
+        assert j.process_prediction("a", 10.0, {"score": 0.9}) == []
+        out = j.process_label({"transaction_id": "a", "is_fraud": True,
+                               "label_ts": 14.0})
+        assert len(out) == 1 and out[0]["is_fraud"] \
+            and out[0]["label_lag_s"] == pytest.approx(4.0)
+        assert j.stats()["matched"] == 1 and len(j) == 0
+
+    def test_early_label_buffers_until_prediction(self):
+        j = LabelJoin(horizon_s=100)
+        j.process_label({"transaction_id": "b", "is_fraud": False,
+                         "label_ts": 5.0})
+        out = j.process_prediction("b", 5.5, {"score": 0.1})
+        assert len(out) == 1 and out[0]["is_fraud"] is False
+
+    def test_unlabeled_prediction_expires_counted(self):
+        j = LabelJoin(horizon_s=10, pred_ooo_s=0, label_ooo_s=0)
+        j.process_prediction("old", 0.0, {"score": 0.5})
+        # advance both watermarks past ts + horizon
+        j.process_prediction("new", 20.0, {"score": 0.5})
+        j.process_label({"transaction_id": "x", "is_fraud": False,
+                         "label_ts": 20.0})
+        assert j.stats()["expired_unlabeled"] == 1
+        # the expired prediction never matches
+        assert j.process_label({"transaction_id": "old", "is_fraud": True,
+                                "label_ts": 21.0}) == []
+
+    def test_duplicate_label_and_replayed_prediction_dedupe(self):
+        j = LabelJoin(horizon_s=100)
+        j.process_label({"transaction_id": "c", "is_fraud": True,
+                         "label_ts": 1.0})
+        j.process_label({"transaction_id": "c", "is_fraud": True,
+                         "label_ts": 1.5})
+        assert j.stats()["duplicate_labels"] == 1
+        j.process_prediction("d", 2.0, {"score": 0.5})
+        assert j.process_prediction("d", 2.1, {"score": 0.5}) == []
+
+    def test_pending_capped_even_with_silent_label_stream(self):
+        # no label ever arrives -> joint watermark never advances, but the
+        # pending table must stay bounded (oldest expire, counted)
+        j = LabelJoin(horizon_s=1e9, max_pending=50)
+        for i in range(200):
+            j.process_prediction(f"p{i}", float(i), {"score": 0.5})
+        assert len(j) <= 50
+        assert j.stats()["expired_unlabeled"] == 150
+        # the survivors are the NEWEST predictions
+        assert j.process_label({"transaction_id": "p199", "is_fraud": True,
+                                "label_ts": 300.0})
+
+    def test_replay_after_match_never_double_counts(self):
+        j = LabelJoin(horizon_s=100)
+        j.process_prediction("e", 1.0, {"score": 0.9})
+        label = {"transaction_id": "e", "is_fraud": True, "label_ts": 2.0}
+        assert len(j.process_label(label)) == 1
+        # label redelivered after the match fired: dropped, counted
+        assert j.process_label(dict(label)) == []
+        assert j.stats()["duplicate_labels"] == 1
+        # prediction redelivered after the match fired: no re-buffer, so a
+        # further label replay still can't re-match
+        assert j.process_prediction("e", 1.0, {"score": 0.9}) == []
+        assert j.process_label(dict(label)) == []
+        assert j.stats()["matched"] == 1
+
+    def test_make_label_events_chargeback_shape(self):
+        rng = np.random.default_rng(0)
+        txns = [{"transaction_id": f"t{i}", "is_fraud": i % 2 == 0,
+                 "timestamp_ms": 1000.0 * i} for i in range(200)]
+        events = make_label_events(txns, rng, delay_scale=1.0)
+        assert len(events) == 200
+        lags = {e["transaction_id"]: e["label_ts"] - e["event_ts"]
+                for e in events}
+        fraud_lags = [lags[f"t{i}"] for i in range(0, 200, 2)]
+        legit_lags = [lags[f"t{i}"] for i in range(1, 200, 2)]
+        # chargebacks (fraud) arrive much later than legit confirmations
+        assert np.median(fraud_lags) > 2 * np.median(legit_lags)
+        assert all(v > 0 for v in lags.values())
+        # sorted by label time (topic order)
+        ts = [e["label_ts"] for e in events]
+        assert ts == sorted(ts)
+
+
+# -------------------------------------------------------------------- buffer
+def test_labeled_buffer_bounded_and_class_aware():
+    buf = LabeledExampleBuffer(capacity=100)
+    for i in range(1000):
+        buf.append(np.full(4, i, np.float32), i % 20 == 0, 0.5, float(i))
+    st = buf.stats()
+    assert st["size"] <= 100
+    # positives are 5% of the stream but hold their reserved slots
+    assert st["positives"] == 20
+    arrays = buf.arrays()
+    assert arrays["x"].shape[1] == 4
+    assert (np.diff(arrays["ts"]) >= 0).all()     # time-ordered
+    assert st["evicted"] == 1000 - st["size"]
+
+
+# -------------------------------------------------------------------- policy
+def test_retrain_policy_triggers_on_auc_drop_with_cooldown():
+    p = RetrainPolicy(auc_drop=0.1, min_labels=10, cooldown_s=100)
+    healthy = {"labeled_total": 50,
+               "sliding": {"auc": 0.95}, "fading": {"auc": 0.96}}
+    degraded = {"labeled_total": 50,
+                "sliding": {"auc": 0.80}, "fading": {"auc": 0.95}}
+    assert p.observe(healthy, None, now=0.0) is None
+    t = p.observe(degraded, None, now=1.0)
+    assert t is not None and t["reason"] == "prequential_auc_drop"
+    assert p.observe(degraded, None, now=50.0) is None    # cooldown
+    assert p.observe(degraded, None, now=200.0) is not None
+
+    few = {"labeled_total": 5,
+           "sliding": {"auc": 0.5}, "fading": {"auc": 0.99}}
+    assert RetrainPolicy(min_labels=10).observe(few, None, 0.0) is None
+
+
+def test_retrain_policy_drift_trigger():
+    class Report:
+        drifted = True
+        max_psi = 0.4
+        top_features = [3, 7]
+
+    p = RetrainPolicy(min_labels=0, use_drift=True)
+    t = p.observe({"labeled_total": 1, "sliding": {"auc": float("nan")},
+                   "fading": {"auc": float("nan")}}, Report(), now=0.0)
+    assert t["reason"] == "feature_drift" and t["max_psi"] == 0.4
+
+
+def test_promotion_gate_non_regression_and_min_positives():
+    gate = PromotionGate(min_positives=5, operating_threshold=0.5)
+    y = np.array([1] * 20 + [0] * 80, float)
+    served = np.clip(0.6 * y + 0.2 * np.random.default_rng(0).random(100),
+                     0, 1)
+    better = np.clip(served + 0.2 * y, 0, 1)
+    worse = np.clip(served - 0.5 * y, 0, 1)
+    ok = gate.evaluate({"strategy": "weighted_average", "holdout": {
+        "y": y, "as_served": served, "candidate": better}})
+    assert ok["passed"] and ok["auc_candidate"] >= ok["auc_as_served"]
+    bad = gate.evaluate({"strategy": "weighted_average", "holdout": {
+        "y": y, "as_served": served, "candidate": worse}})
+    assert not bad["passed"] and bad["reason"] == "auc_regression"
+    thin = gate.evaluate({"strategy": "weighted_average", "holdout": {
+        "y": y[16:26], "as_served": served[16:26],
+        "candidate": better[16:26]}})    # only 4 labeled positives
+    assert not thin["passed"] and "insufficient" in thin["reason"]
+
+
+# ----------------------------------------------------------------- simulator
+def test_simulator_drift_injection_is_labeled_and_in_band():
+    from realtime_fraud_detection_tpu.sim.simulator import (
+        TransactionGenerator,
+    )
+
+    gen = TransactionGenerator(num_users=200, num_merchants=100, seed=9)
+    gen.inject_drift(0.2)
+    txns = gen.generate_batch(800)
+    drifted = [t for t in txns if t.get("fraud_type") == "drifted_pattern"]
+    assert 80 <= len(drifted) <= 260
+    for t in drifted[:20]:
+        assert t["is_fraud"] is True
+        assert t["payment_method"] == "digital_wallet"
+        assert t["fraud_score"] < 0.3       # benign-looking prior
+    gen.clear_drift()
+    assert not [t for t in gen.generate_batch(300)
+                if t.get("fraud_type") == "drifted_pattern"]
+
+
+# ------------------------------------------------------- the closed-loop drill
+@pytest.fixture(scope="module")
+def drill_run():
+    """ONE `rtfd feedback-drill --fast` through the real CLI: the smoke
+    test and the stdout-contract test share this run."""
+    from realtime_fraud_detection_tpu import cli
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = cli.main(["feedback-drill", "--fast"])
+    lines = [ln for ln in buf.getvalue().strip().splitlines() if ln.strip()]
+    return rc, lines
+
+
+def test_feedback_drill_closed_loop(drill_run):
+    """The ISSUE 3 acceptance drill: injected drift -> prequential AUC dip
+    -> retrain trigger -> promotion only after gate-pass -> AUC recovers;
+    the gate-failed control left the serving blend bit-identical."""
+    rc, lines = drill_run
+    assert rc == 0
+    full = json.loads(lines[-2])
+    assert full["passed"] is True
+    assert full["auc_dipped"] is True
+    assert full["baseline_auc"] - full["dip_auc"] >= 0.05
+    assert full["retrain_triggered"] is True
+    assert full["trigger_reason"] in ("prequential_auc_drop",
+                                     "prequential_auc_floor",
+                                     "feature_drift")
+    # no promotion ever on a gate-fail; rejected candidate = bit-identical
+    assert full["gate_control_rejected"] is True
+    assert full["blend_unchanged_on_reject"] is True
+    assert full["policy"]["gate_fail"] >= 1
+    assert full["policy"]["promotions"] == full["policy"]["gate_pass"] == 1
+    # promotion only after gate-pass, through the reload recipe
+    assert full["promoted"] is True
+    assert full["gate"]["passed"] is True
+    assert full["gate"]["auc_candidate"] > full["gate"]["auc_as_served"]
+    # and live quality recovers under the still-flowing drifted pattern
+    assert full["recovered_auc"] >= full["baseline_auc"] - 0.05
+    # label-join hygiene: everything matched or explicitly accounted
+    lj = full["label_join"]
+    assert lj["matched"] > 3000 and lj["orphan_labels"] == 0
+
+
+def test_feedback_drill_final_line_is_compact_parseable_json(drill_run):
+    rc, lines = drill_run
+    final = lines[-1]
+    assert len(final.encode()) < 2048
+    compact = json.loads(final)
+    assert compact["metric"] == "feedback_drill"
+    assert compact["passed"] is True
+    for key in ("baseline_auc", "dip_auc", "recovered_auc",
+                "retrain_triggered", "gate_control_rejected",
+                "blend_unchanged_on_reject", "promoted"):
+        assert key in compact
+
+
+# ------------------------------------------------------------------- serving
+@pytest.fixture(scope="module")
+def feedback_app():
+    from realtime_fraud_detection_tpu.serving.app import ServingApp
+    from realtime_fraud_detection_tpu.utils.config import Config
+
+    cfg = Config()
+    cfg.feedback.enabled = True
+    cfg.feedback.min_labels = 10 ** 9       # endpoints only, never retrain
+    return ServingApp(config=cfg)
+
+
+def test_serving_label_ingest_quality_live_and_prometheus(feedback_app):
+    from realtime_fraud_detection_tpu.sim.simulator import (
+        TransactionGenerator,
+    )
+
+    app = feedback_app
+    gen = TransactionGenerator(num_users=50, num_merchants=20, seed=2)
+    txns = gen.generate_batch(8)
+    results = app._score_batch_sync(txns)
+    labels = [{"transaction_id": r["transaction_id"],
+               "is_fraud": bool(t.get("is_fraud"))}
+              for t, r in zip(txns, results)]
+    status, payload = asyncio.run(app._ingest_labels(labels, {}))
+    assert status == 200 and payload["matched"] == 8
+    status, q = asyncio.run(app._quality_live(None, {}))
+    assert status == 200
+    assert q["prequential"]["labeled_total"] == 8
+    assert q["label_join"]["matched"] == 8
+    assert q["buffer"]["size"] == 8
+    _, prom = asyncio.run(app._metrics_prometheus(None, {}))
+    assert "prequential_auc" in prom
+    assert 'feedback_labels_total{outcome="matched"} 8' in prom
+
+
+def test_serving_label_ingest_validates(feedback_app):
+    from realtime_fraud_detection_tpu.serving.httpd import HttpError
+
+    with pytest.raises(HttpError) as ei:
+        asyncio.run(feedback_app._ingest_labels([{"is_fraud": True}], {}))
+    assert ei.value.status == 422
+
+
+def test_reload_models_refuses_text_arch_mismatch(feedback_app, tmp_path):
+    from realtime_fraud_detection_tpu.checkpoint import CheckpointManager
+    from realtime_fraud_detection_tpu.serving.httpd import HttpError
+
+    ck_dir = tmp_path / "ck"
+    CheckpointManager(str(ck_dir)).save(
+        0, metadata={"text_model": {"hidden_size": 128, "num_layers": 2}})
+    art = tmp_path / "quality.json"
+    art.write_text(json.dumps({
+        "protocol": {"text_model": {"hidden_size": 768, "num_layers": 6}},
+        "selected_blend": {"weights": {"xgboost_primary": 1.0}},
+    }))
+    with pytest.raises(HttpError) as ei:
+        asyncio.run(feedback_app._reload_models(
+            {"checkpoint_dir": str(ck_dir), "quality_artifact": str(art)},
+            {}))
+    assert ei.value.status == 409
+    assert "architecture mismatch" in str(ei.value.detail)
+
+
+# ----------------------------------------------- stacked-combiner deployment
+def test_artifact_strategy_deploys_and_ab_refuses_stacking(tmp_path):
+    from realtime_fraud_detection_tpu.testing import ABTestManager
+    from realtime_fraud_detection_tpu.utils.config import Config
+
+    art = tmp_path / "q.json"
+    art.write_text(json.dumps({"selected_blend": {
+        "weights": {"xgboost_primary": 0.7, "isolation_forest": 0.3},
+        "strategy": "stacking"}}))
+    cfg = Config()
+    cfg.apply_quality_artifact(str(art))
+    assert cfg.ensemble.strategy == "stacking"
+    assert not cfg.models["bert_text"].enabled
+    # the host-side A/B canary cannot emulate stacking — it must refuse
+    with pytest.raises(ValueError, match="stacking"):
+        ABTestManager().experiment_from_artifact("exp", str(art))
+    # and a typo'd strategy never deploys
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"selected_blend": {
+        "weights": {"xgboost_primary": 1.0}, "strategy": "stackingg"}}))
+    with pytest.raises(ValueError, match="stackingg"):
+        Config().apply_quality_artifact(str(bad))
+
+
+def test_retrainer_trains_neural_branch_from_buffered_history():
+    from realtime_fraud_detection_tpu.feedback.policy import Retrainer
+
+    rng = np.random.default_rng(4)
+    n, f = 400, 8
+    y = (rng.random(n) < 0.2).astype(np.float32)
+    x = rng.normal(size=(n, f)).astype(np.float32) + y[:, None]
+    arrays = {
+        "x": x, "y": y,
+        "score": np.clip(0.5 * y + 0.3 * rng.random(n), 0, 1)
+                   .astype(np.float32),
+        "ts": np.arange(n, dtype=np.float64),
+        "history": rng.normal(size=(n, 5, f)).astype(np.float32)
+                     + y[:, None, None],
+        "history_len": np.full(n, 5, np.int32),
+    }
+    cand = Retrainer(n_trees=8, depth=3, iforest_trees=16, train_neural=True,
+                     neural_hidden=16, neural_epochs=1).retrain(
+        arrays, weights={"xgboost_primary": 0.5, "isolation_forest": 0.2,
+                         "lstm_sequential": 0.3})
+    assert cand["lstm"] is not None
+    assert np.isfinite(cand["holdout"]["candidate"]).all()
+    assert 0.3 in [round(v, 4) for v in cand["weights"].values()]
+
+
+def test_blend_fn_stacking_differs_and_runs_device_combine():
+    from realtime_fraud_detection_tpu.training.blend_eval import _blend_fn
+
+    rng = np.random.default_rng(0)
+    scores = {"xgboost_primary": rng.random(64).astype(np.float32),
+              "isolation_forest": rng.random(64).astype(np.float32)}
+    w = {"xgboost_primary": 0.8, "isolation_forest": 0.2}
+    wa = _blend_fn(w, "weighted_average")(scores)
+    st = _blend_fn(w, "stacking")(scores)
+    assert wa.shape == st.shape == (64,)
+    assert not np.allclose(wa, st)

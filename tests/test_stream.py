@@ -346,11 +346,13 @@ def test_run_for_depth3_drains_and_scores_everything():
 
 
 def test_topic_contract_mirrors_reference():
-    """29 topics (27 regular + 2 compacted), exact reference names and
-    partition counts (create-topics.sh:60-151)."""
+    """29 reference topics (27 regular + 2 compacted) with exact names and
+    partition counts (create-topics.sh:60-151), plus the framework's one
+    extension: the transaction-labels feedback stream."""
     from realtime_fraud_detection_tpu.stream.topics import TOPIC_SPECS
 
-    assert len(TOPIC_SPECS) == 29
+    assert len(TOPIC_SPECS) == 30
+    assert TOPIC_SPECS[-1].name == "transaction-labels"
     by_name = {t.name: t for t in TOPIC_SPECS}
     assert by_name["payment-transactions"].partitions == 12
     assert by_name["user-profiles"].compacted

@@ -116,6 +116,14 @@ def main() -> int:
     # 2 ---------------------------------------------------- bucket sweep
     bert_config = BertConfig()
     sc = ScorerConfig(text_len=64)
+    # stamp the exact text-encoder architecture this sweep measures, so a
+    # sweep line is never combined with quality numbers from a different
+    # model by assumption (VERDICT Weak #5; bench.py records the same)
+    _emit(stage="text_encoder", num_layers=bert_config.num_layers,
+          hidden_size=bert_config.hidden_size,
+          intermediate_size=bert_config.intermediate_size,
+          num_heads=bert_config.num_heads,
+          vocab_size=bert_config.vocab_size, text_len=sc.text_len)
     models = jax.device_put(init_scoring_models(
         jax.random.PRNGKey(0), bert_config=bert_config,
         feature_dim=sc.feature_dim, node_dim=sc.node_dim))
