@@ -170,18 +170,38 @@ def shard_batch(mesh: Mesh, tree: Any) -> Any:
 
     Host->device transfer point for microbatches: leaves keep their rank, the
     leading dim is split across the ``data`` axis. Scalars/0-d are replicated.
+
+    Batches whose leading dim does not divide the data axis (drain/flush
+    tails smaller than the device count included) are padded up to
+    ``pad_batch_to_mesh`` by replicating row 0 — the ``pad_to_bucket``
+    staging convention, so a pad row is always a well-formed record, never
+    zeros that could NaN a branch. Callers that track validity keep their
+    own mask (the scorer's staging mask rides INSIDE the packed blobs and
+    already marks these rows invalid); callers without one slice results
+    back to the original row count.
     """
+    d = local_mesh_size(mesh)
 
     def _put(x):
         arr = np.asarray(x)
         if arr.ndim == 0:
             return jax.device_put(arr, replicated_sharding(mesh))
+        n = arr.shape[0]
+        if n % d != 0:
+            m = pad_batch_to_mesh(n, mesh)
+            arr = np.concatenate(
+                [arr, np.broadcast_to(arr[:1], (m - n,) + arr.shape[1:])],
+                axis=0)
         return jax.device_put(arr, batch_sharding(mesh, arr.ndim - 1))
 
     return jax.tree_util.tree_map(_put, tree)
 
 
 def pad_batch_to_mesh(n: int, mesh: Mesh) -> int:
-    """Smallest batch >= n divisible by the data axis size."""
+    """Smallest batch >= max(n, 1) divisible by the data axis size.
+
+    Tolerates n smaller than the device count (a 3-row flush tail on an
+    8-chip mesh pads to 8, never crashes); n == 0 still returns one full
+    data-axis row so a degenerate caller gets a shardable shape."""
     d = local_mesh_size(mesh)
-    return int(math.ceil(n / d) * d)
+    return int(math.ceil(max(n, 1) / d) * d)

@@ -330,6 +330,31 @@ class MetricsCollector:
         # counters by deltas (keeps the _total series honest counters —
         # rate()/increase() and promtool lint stay valid)
         self._host_cache_seen: Dict[Tuple[str, str], float] = {}
+        # device-pool scoring plane (scoring/device_pool.py): per-device
+        # dispatch/completion/retry counters, live in-flight depth and
+        # cumulative queue-wait — mirrored from DevicePool.stats() by
+        # sync_device_pool at exposition time, same registry/exposition
+        self.pool_dispatched = r.counter(
+            "device_pool_dispatched_total",
+            "Microbatches dispatched to each pool replica", ("device",))
+        self.pool_completed = r.counter(
+            "device_pool_completed_total",
+            "Microbatches completed by each pool replica", ("device",))
+        self.pool_retries = r.counter(
+            "device_pool_retries_total",
+            "Batches rescued ONTO this replica after another replica "
+            "failed mid-flight", ("device",))
+        self.pool_inflight = r.gauge(
+            "device_pool_inflight",
+            "Batches currently in flight on each pool replica", ("device",))
+        self.pool_healthy = r.gauge(
+            "device_pool_healthy_replicas",
+            "Replicas currently in the dispatch rotation")
+        self.pool_queue_wait = r.counter(
+            "device_pool_queue_wait_ms_total",
+            "Cumulative milliseconds dispatch spent blocked on a replica "
+            "at full in-flight depth", ("device",))
+        self._pool_seen: Dict[Tuple[str, str], float] = {}
         # continuous-learning plane (feedback/): prequential quality under
         # live labels, label-join health, and the retrain/gate/promotion
         # audit counters — mirrored from FeedbackPlane.snapshot() by
@@ -394,6 +419,29 @@ class MetricsCollector:
                 self.host_stage_ms.set(float(st.get(stat, 0.0)),
                                        stage=stage,
                                        stat=stat.replace("_ms", ""))
+
+    def sync_device_pool(self, stats: Mapping[str, Any]) -> None:
+        """Mirror ``DevicePool.stats()`` into the Prometheus series.
+
+        Called at exposition time (the pool's hot path never touches the
+        metrics lock); cumulative counters mirror as deltas against
+        last-seen values — the same honest-counter scheme as
+        sync_host_stats."""
+        for dev in stats.get("devices") or ():
+            name = str(dev.get("device", dev.get("index", "?")))
+            for kind, counter in (("dispatched", self.pool_dispatched),
+                                  ("completed", self.pool_completed),
+                                  ("retries", self.pool_retries),
+                                  ("queue_wait_ms", self.pool_queue_wait)):
+                total = float(dev.get(kind, 0))
+                key = (name, kind)
+                delta = total - self._pool_seen.get(key, 0.0)
+                if delta > 0:
+                    counter.inc(delta, device=name)
+                self._pool_seen[key] = total
+            self.pool_inflight.set(float(dev.get("inflight", 0)),
+                                   device=name)
+        self.pool_healthy.set(float(stats.get("healthy", 0)))
 
     def sync_feedback(self, snapshot: Mapping[str, Any]) -> None:
         """Mirror a ``FeedbackPlane.snapshot()`` into the Prometheus

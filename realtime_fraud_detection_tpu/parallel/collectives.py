@@ -37,9 +37,23 @@ def all_gather_seq(x, axis: int = 0):
     return jax.lax.all_gather(x, SEQ_AXIS, axis=axis, tiled=True)
 
 
+def _static_axis_size(axis: str) -> int:
+    """Trace-time axis size as a Python int (needed for ppermute's static
+    permutation and fori_loop trip counts). ``jax.lax.axis_size`` where it
+    exists; on 0.4.x, read the axis environment the shard_map trace
+    installed."""
+    asz = getattr(jax.lax, "axis_size", None)
+    if asz is not None:
+        return asz(axis)
+    from jax.core import axis_frame
+
+    frame = axis_frame(axis)
+    return int(getattr(frame, "size", frame))
+
+
 def ppermute_seq(x, shift: int = 1):
     """Ring shift over the seq axis (ring attention's KV rotation)."""
-    n = jax.lax.axis_size(SEQ_AXIS)
+    n = _static_axis_size(SEQ_AXIS)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return jax.lax.ppermute(x, SEQ_AXIS, perm)
 
@@ -54,15 +68,30 @@ def seq_index():
 
 
 def seq_size():
-    return jax.lax.axis_size(SEQ_AXIS)
+    return _static_axis_size(SEQ_AXIS)
 
 
 def shard_map_over(mesh: Mesh, fn, in_specs, out_specs, check_rep: bool = False):
-    """``jax.shard_map`` pinned to this framework's mesh axis names."""
-    return jax.shard_map(
-        fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-        check_vma=check_rep,
-    )
+    """``shard_map`` pinned to this framework's mesh axis names.
+
+    Version shim: newer JAX exposes ``jax.shard_map`` with the
+    ``check_vma`` keyword; 0.4.x has it at ``jax.experimental.shard_map``
+    with ``check_rep``. Resolve whichever this interpreter ships — every
+    collective call site goes through here, so the compatibility decision
+    lives in exactly one place.
+    """
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        try:
+            return sm(fn, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_vma=check_rep)
+        except TypeError:
+            return sm(fn, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=check_rep)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(fn, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=check_rep)
 
 
 def identity_spec() -> P:

@@ -76,9 +76,20 @@ def pipeline_forward(
     n_micro = jax.tree.leaves(microbatches)[0].shape[0]
 
     def device_body(params, mb):
-        # params: [1, ...] (own stage's rows), mb: [M, mb, ...] (replicated)
-        my_params = jax.tree.map(lambda x: x[0], params)
+        # params: [S, ...] (replicated), mb: [M, mb, ...] (replicated).
+        # Each device gathers its own stage's rows by axis index rather
+        # than receiving a P(axis)-split input: jax 0.4.x's partitioner
+        # miscompiles shard_map inputs split over a non-leading mesh axis
+        # when the operand is a traced INTERMEDIATE (values arrive scaled
+        # by the data-axis size — a spurious cross-axis reduction), and
+        # callers like bert_pipeline_encode stack the stage params inside
+        # their jit. Replicated-in + local gather is immune, at the cost
+        # of each device holding all S stages' weights — revisit when the
+        # models outgrow per-device HBM.
         stage = jax.lax.axis_index(axis)
+        my_params = jax.tree.map(
+            lambda x: jax.lax.dynamic_index_in_dim(
+                x, stage, axis=0, keepdims=False), params)
         is_first = stage == 0
         is_last = stage == n_stages - 1
         zero = jax.tree.map(lambda m: jnp.zeros_like(m[0]), mb)
@@ -122,7 +133,7 @@ def pipeline_forward(
             axis)
 
     in_specs = (
-        jax.tree.map(lambda _: P(axis), stage_params),
+        jax.tree.map(lambda _: P(), stage_params),   # replicated; see body
         P(),                                     # microbatches replicated
     )
     return shard_map_over(

@@ -202,8 +202,7 @@ OUT_COLUMNS: tuple[str, ...] = (
 )
 
 
-@partial(jax.jit, static_argnames=("spec", "bert_config", "use_pallas"))
-def score_fused_packed(
+def _score_fused_packed_impl(
     models: ScoringModels,
     blob_f32: jax.Array,             # f32[B, Wf] — packed float leaves
     blob_i32: jax.Array,             # i32[B, Wi] — packed int leaves
@@ -244,6 +243,27 @@ def score_fused_packed(
     cols = [out[name].astype(jnp.float32) for name in OUT_COLUMNS]
     return jnp.concatenate(
         [jnp.stack(cols, axis=1), out["model_predictions"]], axis=1)
+
+
+score_fused_packed = partial(
+    jax.jit, static_argnames=("spec", "bert_config", "use_pallas"),
+)(_score_fused_packed_impl)
+
+# Donated-input variant for the device pool's per-replica dispatch
+# (scoring/device_pool.py): the packed blobs are throwaway H2D staging —
+# fresh per dispatch, never read back — so donating them lets XLA reuse
+# the buffers instead of holding depth x 3 blobs per replica alive, which
+# is what cuts the batch-256 h2d p99 tail (BENCH_r05). The host keeps its
+# own numpy copy for the retry-on-replica-failure path, so donation never
+# loses data. Fall back to the plain entry on jax builds without
+# donate_argnames.
+try:
+    score_fused_packed_donated = partial(
+        jax.jit, static_argnames=("spec", "bert_config", "use_pallas"),
+        donate_argnames=("blob_f32", "blob_i32", "blob_u8", "blob_bf16"),
+    )(_score_fused_packed_impl)
+except TypeError:  # pragma: no cover - older jax
+    score_fused_packed_donated = score_fused_packed
 
 
 @dataclasses.dataclass

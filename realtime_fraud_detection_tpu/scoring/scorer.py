@@ -92,6 +92,10 @@ class PendingScore:
     # response must describe the program that actually ran.
     model_valid: Optional[np.ndarray] = None
     rules_only: bool = False
+    # pooled dispatch (scoring/device_pool.py): the PoolToken finalize
+    # resolves through DevicePool.wait (retry-on-replica-failure) instead
+    # of a plain device_get. None = single-device path.
+    pool_token: Optional[Any] = None
 
 
 class _EntityIndex:
@@ -370,6 +374,11 @@ class FraudScorer:
         self._join_cache = EntityRowCache()
         self._staging = _StagingBuffers()
         self.spans = SpanTimer()
+        # device-pool scoring plane (scoring/device_pool.py): when attached,
+        # dispatch_assembled routes whole microbatches round-robin across
+        # per-device model replicas instead of sharding one batch over the
+        # mesh — see DevicePool for the ordering/equality contract
+        self._pool = None
         self.last_features = np.zeros((0, self.sc.feature_dim), np.float32)
         self.stats: Dict[str, float] = {"scored": 0, "batches": 0, "total_time_s": 0.0}
         # top-10 global feature importances (reference explanation field,
@@ -393,6 +402,17 @@ class FraudScorer:
             self._mv_cache = (mv.copy(), jax.device_put(mv))
         return self._mv_cache[1]
 
+    # ------------------------------------------------------------- pooling
+    def attach_pool(self, pool) -> None:
+        """Adopt a DevicePool: subsequent dispatches route through it.
+        Called by DevicePool.__init__ — construct the scorer first, then
+        the pool around it."""
+        self._pool = pool
+
+    @property
+    def pool(self):
+        return self._pool
+
     # ---------------------------------------------------------- degradation
     def set_degradation(self, mask: Optional[np.ndarray],
                         rules_only: bool = False, level: int = 0) -> None:
@@ -400,7 +420,12 @@ class FraudScorer:
         for subsequent dispatches (None = full ensemble); ``rules_only``
         swaps the served score for the rule score at response build. Cheap
         host-field writes — the fused program takes validity as a runtime
-        tensor, so stepping the ladder never recompiles."""
+        tensor, so stepping the ladder never recompiles. With a device
+        pool attached the rung fans out to all replicas atomically for
+        free: every pooled dispatch passes the CURRENT host mask and each
+        replica refreshes its device copy by value comparison, so every
+        later dispatch — on any replica — runs the new rung while
+        in-flight batches complete under their dispatch-time snapshot."""
         self._qos_mask = None if mask is None else np.asarray(mask, bool)
         self._qos_rules_only = bool(rules_only)
         self.qos_level = int(level)
@@ -450,6 +475,10 @@ class FraudScorer:
 
         self.models = jax.device_put(models, replicated_sharding(self.mesh))
         self._top_importances = None
+        if self._pool is not None:
+            # replica-by-replica fan-out; in-flight batches keep the params
+            # reference they captured at launch — never mixed within a batch
+            self._pool.set_models(models)
 
     # ---------------------------------------------------------------- assembly
     def assemble(self, records: Sequence[Mapping[str, Any]],
@@ -689,19 +718,28 @@ class FraudScorer:
                 merch_neigh_feat=np.asarray(padded.merch_neigh_feat, bf),
             )
         blobs, spec = pack_tree(padded)
-        sharded = shard_batch(self.mesh, blobs)
         self.spans.record("pack", time.perf_counter() - t_pack)
         t_disp = time.perf_counter()
 
         mv = self.effective_model_valid()
         rules_only = self._qos_rules_only
-        out = score_fused_packed(
-            self.models, sharded["f32"], sharded["i32"], sharded["u8"],
-            spec=spec, params=self.ensemble_params,
-            model_valid=self._model_valid_dev(mv),
-            blob_bf16=sharded["bf16"],
-            bert_config=self.bert_config, use_pallas=self.sc.use_pallas,
-        )
+        token = None
+        if self._pool is not None:
+            # pooled mode: the whole microbatch runs on ONE replica (model
+            # replication, not batch sharding) picked round-robin by the
+            # pool; in-flight depth and retry live there
+            token = self._pool.dispatch_packed(
+                blobs, spec, self.ensemble_params, mv)
+            out = token.out
+        else:
+            sharded = shard_batch(self.mesh, blobs)
+            out = score_fused_packed(
+                self.models, sharded["f32"], sharded["i32"], sharded["u8"],
+                spec=spec, params=self.ensemble_params,
+                model_valid=self._model_valid_dev(mv),
+                blob_bf16=sharded["bf16"],
+                bert_config=self.bert_config, use_pallas=self.sc.use_pallas,
+            )
         # Start the device->host copy NOW (it queues behind the compute):
         # by the time finalize() calls device_get, the transfer is already
         # in flight or done, so the d2h RTT overlaps the next batch's
@@ -715,7 +753,8 @@ class FraudScorer:
         return PendingScore(records=list(records), n=n, out=out,
                             features=np.asarray(batch.features),
                             dispatch_ms=(time.perf_counter() - t0) * 1000.0,
-                            model_valid=mv, rules_only=rules_only)
+                            model_valid=mv, rules_only=rules_only,
+                            pool_token=token)
 
     def finalize(self, pending: "PendingScore", now: Optional[float] = None,
                  lock=None) -> List[Dict[str, Any]]:
@@ -730,7 +769,12 @@ class FraudScorer:
         if pending.n == 0:
             return []
         t_fin = time.perf_counter()
-        out = jax.device_get(pending.out)      # blocks until device is done
+        if pending.pool_token is not None:
+            # pooled completion: DevicePool.wait retries the batch on a
+            # healthy replica if this one's result fetch fails
+            out = self._pool.wait(pending.pool_token)
+        else:
+            out = jax.device_get(pending.out)  # blocks until device is done
         self.spans.record("device_wait", time.perf_counter() - t_fin)
         # processing time = assemble/dispatch + device wait; excludes any
         # pipeline queue wait between dispatch() returning and this call

@@ -91,6 +91,19 @@ class ServingApp:
             promote_fn=lambda cand: promote_candidate(
                 self.scorer, self.config, cand, lock=self._score_lock))
         self._feedback_reacting = False
+        # device-pool scoring (serving.device_pool): replicate the model
+        # onto every addressable device; dispatches from the microbatcher
+        # round-robin across per-device in-flight queues. Implies the
+        # two-phase pipelined batcher (several batches must be in flight
+        # for the replicas to see work) with its depth raised to the
+        # pool's capacity.
+        self.pool = getattr(self.scorer, "pool", None)
+        if sc.device_pool and self.pool is None:
+            from realtime_fraud_detection_tpu.scoring import DevicePool
+
+            self.pool = DevicePool(self.scorer,
+                                   inflight_depth=sc.inflight_depth)
+        two_phase = sc.overlap_assembly or self.pool is not None
         self.batcher = RequestMicrobatcher(
             self._score_batch_sync,
             max_batch=sc.microbatch_max_size,
@@ -100,10 +113,10 @@ class ServingApp:
             # drain task dispatches batch N+1 (cache check + assembly +
             # device launch) while batch N still waits on the device in its
             # finalize task — per-waiter results keep arriving in order
-            dispatch_fn=(self._dispatch_batch_sync
-                         if sc.overlap_assembly else None),
-            finalize_fn=(self._finalize_batch_sync
-                         if sc.overlap_assembly else None),
+            dispatch_fn=(self._dispatch_batch_sync if two_phase else None),
+            finalize_fn=(self._finalize_batch_sync if two_phase else None),
+            pipeline_depth=(self.pool.total_slots()
+                            if self.pool is not None else 2),
         )
         self.http = HttpServer(host if host is not None else sc.host,
                                port if port is not None else sc.port)
@@ -433,6 +446,8 @@ class ServingApp:
     async def _metrics(self, body, query) -> Tuple[int, Any]:
         payload = self.metrics.summary()
         payload["host_assembly"] = self.scorer.host_stats()
+        if self.pool is not None:
+            payload["device_pool"] = self.pool.stats()
         return 200, payload
 
     async def _metrics_prometheus(self, body, query) -> Tuple[int, Any]:
@@ -440,6 +455,8 @@ class ServingApp:
         # feedback plane's prequential/label/promotion series into the
         # registry at scrape time (cheap gauge sets + counter deltas)
         self.metrics.sync_host_stats(self.scorer.host_stats())
+        if self.pool is not None:
+            self.metrics.sync_device_pool(self.pool.stats())
         if self.config.feedback.enabled:
             with self._score_lock:
                 snap = self.feedback.snapshot()

@@ -18,6 +18,14 @@ additionally capped by the OLDEST waiter's remaining latency budget: a
 request that already spent most of its budget queued closes its batch
 early (possibly at size 1) instead of waiting out the full assembly window
 on top — the deadline-aware assembly lever for p99 (arXiv:1904.07421).
+
+The two-phase pipelined mode (``dispatch_fn``/``finalize_fn``) is also the
+device-pool carrier (scoring/device_pool.py): with ``pipeline_depth``
+raised to the pool's capacity (devices x per-replica depth,
+serving/app.py), the drain task keeps dispatching batches while earlier
+ones compute, so the scorer's round-robin pool actually sees enough
+concurrent batches to fill every replica. Completion chaining below keeps
+per-request FIFO regardless of which replica scored which batch.
 """
 
 from __future__ import annotations

@@ -76,6 +76,19 @@ class JobConfig:
     # becomes timing-dependent — keep off where bit-reproducible replays
     # matter, on for throughput.
     overlap_assembly: bool = False
+    # device-pool scoring plane (scoring/device_pool.py): replicate the
+    # scorer's params onto every addressable device and dispatch whole
+    # microbatches round-robin across per-device in-flight queues — the
+    # multi-chip throughput lever (one chip idles seven on a v5e-8
+    # otherwise). Scores stay bit-identical to single-device; completion
+    # (fan-out + commit) stays FIFO. The run loops raise their in-flight
+    # window to the pool's capacity (devices x inflight_depth) so every
+    # replica receives work — the velocity-staleness tradeoff documented
+    # at pipeline_depth scales with that window.
+    device_pool: bool = False
+    # per-replica in-flight depth (>= 2 keeps each device's compute
+    # back-to-back while the next batch's H2D stages)
+    inflight_depth: int = 2
     # deadline-aware QoS plane (qos/): admission control, per-transaction
     # latency budgets (the assembler closes batches early when the oldest
     # waiter's budget runs low), and the degradation ladder fed by the
@@ -174,6 +187,16 @@ class StreamJob:
             self._labels_consumer = broker.consumer(
                 [self.config.labels_topic],
                 f"{self.config.group_id}-labels")
+        # device pool: replicate params onto every addressable device; the
+        # scorer's dispatch_assembled routes through it from here on. An
+        # already-attached pool (caller-constructed) is respected. getattr:
+        # drills drive this job with duck-typed scorer stand-ins
+        self.pool = getattr(scorer, "pool", None)
+        if self.config.device_pool and self.pool is None:
+            from realtime_fraud_detection_tpu.scoring import DevicePool
+
+            self.pool = DevicePool(
+                scorer, inflight_depth=self.config.inflight_depth)
         # overlapped host assembly: scorer.dispatch moves to a background
         # stage thread; this thread keeps admission/dedupe/commit order
         self._stage = None
@@ -192,6 +215,15 @@ class StreamJob:
         # loop dedupes batch N+1 against these before batch N lands in the
         # txn cache (keeps effectively-once scoring under pipelining)
         self._inflight_ids: set = set()
+
+    def _inflight_depth(self) -> int:
+        """Run-loop in-flight window: the configured pipeline depth, raised
+        to the device pool's capacity when one is attached — a window
+        smaller than devices x depth would leave replicas starved."""
+        depth = max(1, self.config.pipeline_depth)
+        if self.pool is not None:
+            depth = max(depth, self.pool.total_slots())
+        return depth
 
     # ----------------------------------------------------------------- steps
     def process_batch(self, records: List[Record],
@@ -580,7 +612,7 @@ class StreamJob:
         from collections import deque
 
         start_scored = self.counters["scored"]
-        depth = max(1, self.config.pipeline_depth)
+        depth = self._inflight_depth()
         in_flight: deque = deque()
         for _ in range(max_batches):
             batch = self.assembler.next_batch(block=False)
@@ -617,7 +649,7 @@ class StreamJob:
 
         t_end = time.monotonic() + duration_s
         start = self.counters["scored"]
-        depth = max(1, self.config.pipeline_depth)
+        depth = self._inflight_depth()
         in_flight: deque = deque()
         while time.monotonic() < t_end:
             batch = self.assembler.next_batch(block=True, timeout_s=0.05)

@@ -116,6 +116,15 @@ class ServingConfig:
     # transaction twice). The serial path closes that window by strict
     # put-before-next-lookup ordering.
     overlap_assembly: bool = False
+    # Device-pool scoring (scoring/device_pool.py): replicate the model
+    # onto every addressable device and dispatch whole microbatches
+    # round-robin with per-replica in-flight depth. Implies the two-phase
+    # pipelined microbatcher (overlap_assembly's machinery) with its
+    # pipeline depth raised to the pool capacity, so the same
+    # idempotent-retry-window tradeoff applies, widened to the pool's
+    # in-flight window.
+    device_pool: bool = False
+    inflight_depth: int = 2
 
 
 @dataclass
