@@ -176,6 +176,11 @@ def cmd_run_job(args: argparse.Namespace) -> int:
         qos_settings = QosSettings(
             enabled=True, budget_ms=args.qos_budget_ms,
             admission_rate=args.qos_rate)
+    tracing_settings = None
+    if getattr(args, "trace", False):
+        from realtime_fraud_detection_tpu.utils.config import TracingSettings
+
+        tracing_settings = TracingSettings(enabled=True)
     job = StreamJob(broker, scorer, JobConfig(
         max_batch=args.batch, enable_analytics=args.analytics,
         enable_enrichment=args.enrichment,
@@ -183,7 +188,8 @@ def cmd_run_job(args: argparse.Namespace) -> int:
         feedback=feedback_plane,
         overlap_assembly=getattr(args, "overlap_assembly", False),
         device_pool=getattr(args, "device_pool", False),
-        inflight_depth=getattr(args, "inflight_depth", 2)))
+        inflight_depth=getattr(args, "inflight_depth", 2),
+        tracing=tracing_settings))
 
     metadata: Optional[MetadataStore] = None
     ckpt: Optional[CheckpointManager] = None
@@ -272,6 +278,15 @@ def cmd_run_job(args: argparse.Namespace) -> int:
             "buffer": snap["buffer"]["size"],
             "policy": snap["policy"],
         }
+    if job.tracer is not None:
+        bd = job.tracer.breakdown()
+        slo = job.tracer.slo.snapshot()
+        summary["tracing"] = {
+            "traces": bd["n"],
+            "p99": bd["quantiles"].get("p99"),
+            "slo_fast": slo["windows"]["fast"],
+            "counters": dict(job.tracer.counters),
+        }
     if job.analytics is not None:
         summary["analytics"] = {
             k: v["fired"] for k, v in job.analytics.stats().items()}
@@ -295,6 +310,8 @@ def cmd_serve(args: argparse.Namespace) -> int:
         config.qos.budget_ms = args.qos_budget_ms
     if getattr(args, "qos_rate", None):
         config.qos.admission_rate = args.qos_rate
+    if getattr(args, "trace", False):
+        config.tracing.enabled = True
     if getattr(args, "overlap_assembly", False):
         config.serving.overlap_assembly = True
     if getattr(args, "device_pool", False):
@@ -816,6 +833,80 @@ def cmd_feedback_drill(args: argparse.Namespace) -> int:
     return 0 if summary["passed"] else 1
 
 
+def cmd_trace_drill(args: argparse.Namespace) -> int:
+    """Deterministic tracing drill (obs/trace_drill.py): the real stream
+    path on a virtual clock with an injected slow stage. Pins that the
+    critical-path analyzer names the right culprit (slow assembly ->
+    `assemble`, slow device -> `device_wait`), that the SLO burn rate
+    reacts to the injected violation and recovers (engaging/releasing the
+    QoS gate), that FIFO/shed behavior is identical with tracing on, and
+    that per-txn tracing overhead stays under the pinned bound. Prints
+    the full summary, then a compact (<2 KB) verdict as the FINAL stdout
+    line (bench.py convention). Exit 1 unless every check passed."""
+    import dataclasses as _dc
+
+    from realtime_fraud_detection_tpu.obs.trace_drill import (
+        TraceDrillConfig,
+        compact_trace_summary,
+        run_trace_drill,
+    )
+
+    cfg = TraceDrillConfig.fast() if args.fast else TraceDrillConfig()
+    cfg = _dc.replace(cfg, seed=args.seed)
+    summary = run_trace_drill(cfg)
+    print(json.dumps(summary), flush=True)
+    print(json.dumps(compact_trace_summary(summary),
+                     separators=(",", ":")), flush=True)
+    return 0 if summary["passed"] else 1
+
+
+def cmd_trace_export(args: argparse.Namespace) -> int:
+    """Run a traced fake-Kafka job and export the captured window as
+    Chrome-trace/Perfetto JSON (load in ui.perfetto.dev or
+    chrome://tracing). The flight recorder's ring plus the slowest-N
+    exemplars land in the file; a one-line capture summary goes to
+    stdout."""
+    from realtime_fraud_detection_tpu.obs.tracing import Tracer
+    from realtime_fraud_detection_tpu.scoring import FraudScorer, ScorerConfig
+    from realtime_fraud_detection_tpu.sim.simulator import TransactionGenerator
+    from realtime_fraud_detection_tpu.stream import (
+        InMemoryBroker,
+        JobConfig,
+        StreamJob,
+    )
+    from realtime_fraud_detection_tpu.stream import topics as T
+    from realtime_fraud_detection_tpu.utils.config import TracingSettings
+
+    gen = TransactionGenerator(num_users=args.users,
+                               num_merchants=args.merchants,
+                               seed=args.seed, tps=args.tps)
+    scorer = FraudScorer(scorer_config=ScorerConfig())
+    scorer.seed_profiles(gen.users.profiles(), gen.merchants.profiles())
+    tracer = Tracer(TracingSettings(enabled=True,
+                                    ring_size=max(64, args.count)))
+    broker = InMemoryBroker()
+    job = StreamJob(broker, scorer, JobConfig(
+        max_batch=args.batch, tracing=tracer, emit_features=False))
+    produced = 0
+    while produced < args.count:
+        chunk = min(args.count - produced, 10_000)
+        broker.produce_batch(T.TRANSACTIONS, gen.generate_batch(chunk),
+                             key_fn=lambda r: str(r["user_id"]))
+        produced += chunk
+        job.run_until_drained()
+    payload = tracer.export_chrome_trace()
+    with open(args.out, "w") as f:
+        json.dump(payload, f)
+    bd = tracer.breakdown()
+    print(json.dumps({
+        "traces": bd["n"],
+        "events": len(payload["traceEvents"]),
+        "p99": bd["quantiles"].get("p99"),
+        "out": args.out,
+    }))
+    return 0
+
+
 def cmd_pool_drill(args: argparse.Namespace) -> int:
     """Deterministic device-pool drill (scoring/pool_drill.py): the real
     pooled scoring path on N host-platform virtual devices, pinning
@@ -989,6 +1080,10 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--feedback-delay-scale", type=float, default=1e-4,
                     help="compresses the chargeback label-delay "
                          "distribution (1.0 = realistic days)")
+    sp.add_argument("--trace", action="store_true",
+                    help="enable the per-transaction tracing plane "
+                         "(obs/tracing.py): flight recorder, latency "
+                         "breakdown, SLO burn rate in the summary")
     sp.set_defaults(fn=cmd_run_job)
 
     sp = sub.add_parser("serve", help="run the scoring HTTP service")
@@ -1026,6 +1121,10 @@ def build_parser() -> argparse.ArgumentParser:
                     help="combine a checkpoint and quality artifact even "
                          "when their recorded text-encoder architectures "
                          "differ (refused by default)")
+    sp.add_argument("--trace", action="store_true",
+                    help="enable the per-transaction tracing plane: "
+                         "GET /latency/breakdown, GET /slo, trace_* "
+                         "Prometheus series")
     sp.set_defaults(fn=cmd_serve)
 
     sp = sub.add_parser("train", help="train tree models on synthetic data")
@@ -1161,6 +1260,27 @@ def build_parser() -> argparse.ArgumentParser:
                     help="fraction of the stream turned into the drifted "
                          "fraud pattern")
     sp.set_defaults(fn=cmd_feedback_drill)
+
+    sp = sub.add_parser("trace-drill",
+                        help="deterministic tracing drill (virtual "
+                             "clock, injected slow stage, SLO burn + "
+                             "overhead pins)")
+    sp.add_argument("--fast", action="store_true",
+                    help="tier-1 sizes (the CI smoke configuration)")
+    sp.add_argument("--seed", type=int, default=7)
+    sp.set_defaults(fn=cmd_trace_drill)
+
+    sp = sub.add_parser("trace-export",
+                        help="run a traced fake-Kafka job and export "
+                             "Chrome-trace/Perfetto JSON")
+    _add_sim_args(sp)
+    sp.add_argument("--count", type=int, default=2048,
+                    help="transactions to score through the traced job")
+    sp.add_argument("--batch", type=int, default=128)
+    sp.add_argument("--out", default="trace.json",
+                    help="Chrome-trace JSON output path (open in "
+                         "ui.perfetto.dev)")
+    sp.set_defaults(fn=cmd_trace_export)
 
     sp = sub.add_parser("pool-drill",
                         help="deterministic device-pool drill (virtual "

@@ -30,6 +30,12 @@ from realtime_fraud_detection_tpu.obs.profiling import (
     annotate,
     device_trace,
 )
+from realtime_fraud_detection_tpu.obs.tracing import (
+    SloTracker,
+    TraceBatch,
+    TraceContext,
+    Tracer,
+)
 
 __all__ = [
     "Counter",
@@ -41,7 +47,11 @@ __all__ = [
     "JsonFormatter",
     "MetricsCollector",
     "Registry",
+    "SloTracker",
     "SpanTimer",
+    "TraceBatch",
+    "TraceContext",
+    "Tracer",
     "annotate",
     "device_trace",
     "log_batch_scored",

@@ -150,6 +150,11 @@ class Histogram(_Metric):
         self._counts: Dict[Tuple[Tuple[str, str], ...], List[int]] = {}
         self._sums: Dict[Tuple[Tuple[str, str], ...], float] = {}
         self._maxes: Dict[Tuple[Tuple[str, str], ...], float] = {}
+        # one exemplar per series: (bucket_index, labels dict, value) —
+        # rendered OpenMetrics-style on the matching bucket line (the
+        # trace_stage_ms series attaches trace_ids this way)
+        self._exemplars: Dict[Tuple[Tuple[str, str], ...],
+                              Tuple[int, Dict[str, str], float]] = {}
 
     def observe(self, value: float, **labels: str) -> None:
         if not math.isfinite(value):
@@ -166,6 +171,44 @@ class Histogram(_Metric):
                     break
             self._sums[key] = self._sums.get(key, 0.0) + float(value)
             self._maxes[key] = max(self._maxes.get(key, value), value)
+
+    def add_bucket_deltas(self, deltas: Sequence[float], sum_delta: float,
+                          max_value: Optional[float] = None,
+                          exemplar: Optional[Mapping[str, Any]] = None,
+                          **labels: str) -> None:
+        """Merge pre-bucketed observation deltas into this histogram.
+
+        The mirror path for externally aggregated histograms (the tracing
+        plane buckets stage durations itself so its hot path never touches
+        this lock): ``deltas`` must align with ``self.buckets`` (+Inf
+        last) and be non-negative — the honest-counter discipline of the
+        sync_* mirrors. ``exemplar`` is ``{"value": v, **labels}``; it
+        replaces the series' stored exemplar and renders as a comment
+        line next to the bucket the value falls in (the classic text
+        format the endpoint serves has no exemplar syntax).
+        """
+        if len(deltas) != len(self.buckets):
+            raise ValueError(
+                f"{self.name}: expected {len(self.buckets)} bucket deltas "
+                f"(incl. +Inf), got {len(deltas)}")
+        if any(d < 0 for d in deltas) or sum_delta < 0:
+            raise ValueError(f"{self.name}: bucket deltas must be >= 0")
+        key = _labels_key(labels)
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * len(self.buckets))
+            for i, d in enumerate(deltas):
+                counts[i] += int(d)
+            self._sums[key] = self._sums.get(key, 0.0) + float(sum_delta)
+            if max_value is not None:
+                self._maxes[key] = max(self._maxes.get(key, max_value),
+                                       float(max_value))
+            if exemplar:
+                ex = dict(exemplar)
+                v = float(ex.pop("value"))
+                idx = next((i for i, ub in enumerate(self.buckets)
+                            if v <= ub), len(self.buckets) - 1)
+                self._exemplars[key] = (
+                    idx, {str(k): str(val) for k, val in ex.items()}, v)
 
     def count(self, **labels: str) -> int:
         return sum(self._counts.get(_labels_key(labels), ()))
@@ -197,11 +240,27 @@ class Histogram(_Metric):
                      f"# TYPE {self.name} {self.kind}"]
             for key in keys:
                 counts = self._counts.get(key, [0] * len(self.buckets))
+                ex = self._exemplars.get(key)
                 cum = 0
-                for ub, c in zip(self.buckets, counts):
+                for i, (ub, c) in enumerate(zip(self.buckets, counts)):
                     cum += c
                     lk = key + (("le", _fmt(ub)),)
-                    lines.append(f"{self.name}_bucket{_render_labels(lk)} {cum}")
+                    lines.append(
+                        f"{self.name}_bucket{_render_labels(lk)} {cum}")
+                    if ex is not None and ex[0] == i:
+                        # exemplar as a standalone comment line: the
+                        # classic text format (version=0.0.4 — what the
+                        # endpoint serves) has no exemplar syntax, and
+                        # trailing content after a sample value fails the
+                        # WHOLE scrape; a leading-# line is ignored by
+                        # every Prometheus parser while staying visible
+                        # to humans and log-grep tooling
+                        ex_labels = ",".join(
+                            f'{k}="{_escape(v)}"' for k, v in ex[1].items())
+                        lines.append(
+                            f"# exemplar {self.name}_bucket"
+                            f"{_render_labels(lk)} {{{ex_labels}}} "
+                            f"{_fmt(ex[2])}")
                 lines.append(
                     f"{self.name}_sum{_render_labels(key)} "
                     f"{_fmt(self._sums.get(key, 0.0))}"
@@ -396,6 +455,31 @@ class MetricsCollector:
         # last-seen totals for the feedback counter mirrors (same honest-
         # counter delta scheme as the host-assembly caches above)
         self._feedback_seen: Dict[Tuple[str, str], float] = {}
+        # tracing plane (obs/tracing.py): per-stage latency histograms
+        # with exemplar trace_ids, trace terminal counters, and the SLO
+        # burn-rate gauges — mirrored from Tracer.snapshot() by
+        # sync_tracing at exposition time so the stream job and the
+        # serving app expose IDENTICAL trace_* series
+        from realtime_fraud_detection_tpu.obs.tracing import (
+            TRACE_STAGE_BUCKETS_MS,
+        )
+
+        self.trace_stage_ms = r.histogram(
+            "trace_stage_ms",
+            "Per-transaction stage latency from the tracing plane "
+            "(exemplars carry trace_ids)", ("stage",),
+            buckets=TRACE_STAGE_BUCKETS_MS)
+        self.trace_completed = r.counter(
+            "trace_completed_total",
+            "Traces closed by the flight recorder", ("terminal",))
+        self.trace_slo_violations = r.counter(
+            "trace_slo_violations_total",
+            "Transactions that blew the SLO latency objective")
+        self.trace_slo_burn = r.gauge(
+            "trace_slo_burn_rate",
+            "SLO error-budget burn rate (1.0 = budget consumed exactly at "
+            "the sustainable rate)", ("window",))
+        self._trace_seen: Dict[Tuple[str, ...], Any] = {}
 
     def sync_host_stats(self, host_stats: Mapping[str, Any]) -> None:
         """Mirror ``FraudScorer.host_stats()`` into the Prometheus series.
@@ -489,6 +573,59 @@ class MetricsCollector:
                 policy.get("promotions", 0))
         _mirror(self.feedback_triggers, "triggers", "total",
                 policy.get("triggers", 0), reason="any")
+
+    def sync_tracing(self, snapshot: Mapping[str, Any]) -> None:
+        """Mirror a ``Tracer.snapshot()`` into the Prometheus series.
+
+        Called at exposition time (the tracing hot path never touches the
+        metrics lock); every cumulative quantity mirrors as a DELTA
+        against last-seen values — the same honest-counter discipline as
+        sync_feedback/sync_device_pool, so the stream job and the serving
+        app expose identical, rate()-valid trace_* series. The tracer
+        buckets stage durations with TRACE_STAGE_BUCKETS_MS, matching
+        ``trace_stage_ms`` exactly, so the histogram mirror is a pure
+        bucket-count delta (plus the latest slowest-sample exemplar)."""
+        for stage, st in (snapshot.get("stages") or {}).items():
+            counts = list(st.get("bucket_counts") or ())
+            if len(counts) != len(self.trace_stage_ms.buckets):
+                continue
+            seen_key = ("stage", stage)
+            prev = self._trace_seen.get(seen_key)
+            prev_counts = (prev or {}).get(
+                "bucket_counts", [0] * len(counts))
+            deltas = [max(0, c - p) for c, p in zip(counts, prev_counts)]
+            sum_delta = max(0.0, float(st.get("sum_ms", 0.0))
+                            - float((prev or {}).get("sum_ms", 0.0)))
+            if any(deltas) or sum_delta > 0:
+                ex = st.get("exemplar") or None
+                self.trace_stage_ms.add_bucket_deltas(
+                    deltas, sum_delta, max_value=st.get("max_ms"),
+                    exemplar=({"value": ex["ms"],
+                               "trace_id": ex["trace_id"]} if ex else None),
+                    stage=stage)
+            self._trace_seen[seen_key] = {
+                "bucket_counts": counts,
+                "sum_ms": float(st.get("sum_ms", 0.0))}
+        counters = snapshot.get("counters") or {}
+        for key, terminal in (("completed", "scored"), ("shed", "shed"),
+                              ("errors", "error"), ("cached", "cached")):
+            total = counters.get(key, 0)
+            seen_key = ("terminal", terminal)
+            delta = float(total) - float(self._trace_seen.get(seen_key, 0.0))
+            if delta > 0:
+                self.trace_completed.inc(delta, terminal=terminal)
+            self._trace_seen[seen_key] = float(total)
+        slo = snapshot.get("slo") or {}
+        seen_key = ("slo", "violations")
+        total = float(slo.get("violations_total", 0))
+        delta = total - float(self._trace_seen.get(seen_key, 0.0))
+        if delta > 0:
+            self.trace_slo_violations.inc(delta)
+        self._trace_seen[seen_key] = total
+        for window, w in (slo.get("windows") or {}).items():
+            burn = w.get("burn_rate")
+            if burn is not None and math.isfinite(float(burn)):
+                self.trace_slo_burn.set(float(burn), window=window)
 
     # ------------------------------------------------------------- recording
     def record_prediction(self, decision: str, fraud_score: float,

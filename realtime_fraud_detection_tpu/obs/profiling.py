@@ -19,7 +19,22 @@ import time
 from collections import deque
 from typing import Dict, Iterator, Optional
 
-__all__ = ["device_trace", "SpanTimer", "annotate"]
+__all__ = ["device_trace", "SpanTimer", "annotate",
+           "interpolated_percentile"]
+
+
+def interpolated_percentile(xs_sorted, q: float) -> float:
+    """Linear-interpolated percentile over a SORTED sample (numpy's
+    default convention), unit-agnostic. The one implementation shared by
+    SpanTimer.stats and the tracing plane's breakdown — raw index
+    selection made small-n tails dishonest (p99 on n<100 was simply the
+    max)."""
+    pos = q * (len(xs_sorted) - 1)
+    lo = int(pos)
+    frac = pos - lo
+    if lo + 1 >= len(xs_sorted):
+        return float(xs_sorted[-1])
+    return float(xs_sorted[lo] + (xs_sorted[lo + 1] - xs_sorted[lo]) * frac)
 
 
 @contextlib.contextmanager
@@ -67,22 +82,24 @@ class SpanTimer:
                 name, deque(maxlen=self._max)).append(seconds)
 
     def stats(self, name: Optional[str] = None) -> Dict[str, Dict[str, float]]:
+        # snapshot the deques under the lock; the O(n log n) sort and the
+        # percentile math run outside it — a stats() reader must never
+        # stall the hot path's record() behind a 10k-sample sort
         with self._lock:
             names = [name] if name else list(self._spans)
-            out: Dict[str, Dict[str, float]] = {}
-            for n in names:
-                xs = sorted(self._spans.get(n, ()))
-                if not xs:
-                    continue
-                out[n] = {
-                    "count": len(xs),
-                    "total_s": sum(xs),
-                    "mean_ms": 1e3 * sum(xs) / len(xs),
-                    "p50_ms": 1e3 * xs[len(xs) // 2],
-                    "p99_ms": 1e3 * xs[min(int(0.99 * len(xs)),
-                                           len(xs) - 1)],
-                    "max_ms": 1e3 * xs[-1],
-                }
+            snap = {n: list(self._spans[n]) for n in names
+                    if self._spans.get(n)}
+        out: Dict[str, Dict[str, float]] = {}
+        for n, xs in snap.items():
+            xs.sort()
+            out[n] = {
+                "count": len(xs),
+                "total_s": sum(xs),
+                "mean_ms": 1e3 * sum(xs) / len(xs),
+                "p50_ms": 1e3 * interpolated_percentile(xs, 0.50),
+                "p99_ms": 1e3 * interpolated_percentile(xs, 0.99),
+                "max_ms": 1e3 * xs[-1],
+            }
         return out
 
     def reset(self) -> None:

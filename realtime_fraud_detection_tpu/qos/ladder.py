@@ -107,10 +107,14 @@ class DegradationLadder:
             self._under = 0
         return self.level
 
-    def level_mask(self, model_names: Sequence[str]) -> np.ndarray:
-        """Branch-validity mask for the CURRENT level over ``model_names``
-        (and-ed with the deployment's own validity in the scorer)."""
-        dropped = self.current.dropped_branches
+    def level_mask(self, model_names: Sequence[str],
+                   level: Optional[int] = None) -> np.ndarray:
+        """Branch-validity mask over ``model_names`` (and-ed with the
+        deployment's own validity in the scorer) — for the CURRENT level
+        by default, or an explicit ``level`` (the SLO-floored effective
+        rung the QoS plane serves)."""
+        rung = LADDER_LEVELS[self.level if level is None else level]
+        dropped = rung.dropped_branches
         return np.asarray([n not in dropped for n in model_names], bool)
 
     def snapshot(self) -> dict:

@@ -46,6 +46,14 @@ class QosPlane:
             up_patience=s.ladder_up_patience or None))
         self.counters: Dict[str, int] = {"admitted": 0, "shed": 0}
         self._lock = threading.Lock()
+        # SLO-burn degradation signal (obs/tracing.py SloTracker feeds
+        # this): an engaged gate floors the served rung at level 1 on top
+        # of the backlog ladder. Same asymmetric-hysteresis discipline as
+        # the ladder — engagement needs `patience` consecutive
+        # over-threshold burn observations, recovery `up_patience` under.
+        self.slo_engaged = False
+        self._slo_over = 0
+        self._slo_under = 0
 
     @property
     def enabled(self) -> bool:
@@ -166,29 +174,76 @@ class QosPlane:
                 direction="down" if level > prev else "up")
         return level
 
+    def observe_slo_burn(self, burn_rate: float,
+                         threshold: float = 2.0,
+                         patience: int = 3,
+                         up_patience: int = 12) -> bool:
+        """Feed one SLO burn-rate observation (the tracing plane's fast
+        window) to the hysteresis gate; returns whether the gate is
+        engaged. An engaged gate makes ``apply_degradation`` serve at
+        least ladder rung 1 (drop BERT/GNN) even while the backlog signal
+        reads calm — latency can burn the error budget without a queue
+        ever forming (e.g. a slow stage, not an arrival spike)."""
+        prev_level = self.effective_level()
+        if burn_rate > threshold:
+            self._slo_over += 1
+            self._slo_under = 0
+            if self._slo_over >= max(1, int(patience)) \
+                    and not self.slo_engaged:
+                self.slo_engaged = True
+                self._slo_over = 0
+        else:
+            self._slo_under += 1
+            self._slo_over = 0
+            if self._slo_under >= max(1, int(up_patience)) \
+                    and self.slo_engaged:
+                self.slo_engaged = False
+                self._slo_under = 0
+        # count a transition only when the SERVED rung actually moved: a
+        # gate flip while the backlog ladder already sits at level >= 1
+        # changes nothing downstream, and double-counting it would make
+        # rate(qos_ladder_transitions) unreadable as "rung changes"
+        level = self.effective_level()
+        if level != prev_level:
+            self.metrics.qos_ladder_transitions.inc(
+                direction="down" if level > prev_level else "up")
+        return self.slo_engaged
+
+    def effective_level(self) -> int:
+        """The rung actually served: the backlog ladder's level, floored
+        at 1 while the SLO-burn gate is engaged."""
+        level = self.ladder.level
+        if self.slo_engaged:
+            level = max(level, 1)
+        return min(level, len(LADDER_LEVELS) - 1)
+
     def apply_degradation(self, scorer) -> int:
-        """Push the current ladder rung into a scorer as a branch-validity
-        mask (+ the rules-only flag for the last rung). The scorer's own
-        deployment validity is preserved — the rung only ever narrows it."""
+        """Push the current rung into a scorer as a branch-validity mask
+        (+ the rules-only flag for the last rung). The scorer's own
+        deployment validity is preserved — the rung only ever narrows it.
+        The rung is the backlog ladder's, floored by the SLO-burn gate
+        (``effective_level``)."""
         from realtime_fraud_detection_tpu.scoring.pipeline import MODEL_NAMES
 
-        level = self.ladder.level
+        level = self.effective_level()
+        rung = LADDER_LEVELS[level]
         if level == 0:
             scorer.set_degradation(None, rules_only=False, level=0)
         else:
-            scorer.set_degradation(self.ladder.level_mask(MODEL_NAMES),
-                                   rules_only=self.ladder.current.rules_only,
-                                   level=level)
+            scorer.set_degradation(
+                self.ladder.level_mask(MODEL_NAMES, level=level),
+                rules_only=rung.rules_only, level=level)
         if level > 0:
             self.metrics.qos_degraded_scored.inc(
-                0, level=self.ladder.current.name)  # materialize the series
+                0, level=rung.name)  # materialize the series
         return level
 
     def record_scored(self, n: int) -> None:
         """Count transactions scored at the current (degraded) rung."""
-        if n and self.ladder.level > 0:
+        level = self.effective_level()
+        if n and level > 0:
             self.metrics.qos_degraded_scored.inc(
-                n, level=self.ladder.current.name)
+                n, level=LADDER_LEVELS[level].name)
 
     # -------------------------------------------------------------- budget
     def record_completion(self, ingest_ts: float, now: float) -> float:
@@ -218,5 +273,11 @@ class QosPlane:
             },
             "ladder": self.ladder.snapshot(),
             "ladder_levels": [lvl.name for lvl in LADDER_LEVELS],
+            "effective_level": self.effective_level(),
+            "slo_gate": {
+                "engaged": self.slo_engaged,
+                "over_streak": self._slo_over,
+                "under_streak": self._slo_under,
+            },
             "counters": counters,
         }

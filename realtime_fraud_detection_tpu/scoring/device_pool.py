@@ -62,10 +62,10 @@ class PoolToken:
     everything needed to relaunch it elsewhere if its replica dies."""
 
     __slots__ = ("out", "replica_idx", "blobs", "spec", "params",
-                 "model_valid", "t_dispatch")
+                 "model_valid", "t_dispatch", "inflight_at_dispatch")
 
     def __init__(self, out, replica_idx, blobs, spec, params, model_valid,
-                 t_dispatch):
+                 t_dispatch, inflight_at_dispatch=0):
         self.out = out
         self.replica_idx = replica_idx
         self.blobs = blobs              # host numpy copies (retry source)
@@ -73,6 +73,11 @@ class PoolToken:
         self.params = params
         self.model_valid = model_valid  # host bool[M] snapshot
         self.t_dispatch = t_dispatch
+        # the replica's queue depth (this batch included) captured under
+        # the pool lock at assignment — tail-attribution metadata for the
+        # tracing plane (a p99 outlier dispatched at depth 2 waited out a
+        # predecessor's compute; one dispatched at depth 1 did not)
+        self.inflight_at_dispatch = inflight_at_dispatch
 
 
 class _Replica:
@@ -150,10 +155,13 @@ class DevicePool:
         return max(1, self.healthy_count * self.inflight_depth)
 
     # ------------------------------------------------------------- dispatch
-    def _pick_replica(self) -> "_Replica":
+    def _pick_replica(self) -> tuple:
         """Round-robin over healthy replicas; blocks (queue wait) while the
         chosen replica is at depth. Strict rotation — not shortest-queue —
-        so the assignment sequence is deterministic for the drill."""
+        so the assignment sequence is deterministic for the drill.
+        Returns ``(replica, inflight_after_assignment)`` — the depth is
+        captured under the lock so the tracing plane's dispatch metadata
+        is exact, never a racy re-read."""
         with self._cv:
             n = len(self.replicas)
             for off in range(n):
@@ -175,7 +183,7 @@ class DevicePool:
             rep.inflight += 1
             rep.dispatched += 1
             self.assignment_log.append(rep.idx)
-            return rep
+            return rep, rep.inflight
 
     def _launch(self, rep: "_Replica", blobs: Dict[str, np.ndarray], spec,
                 params, model_valid: np.ndarray):
@@ -205,7 +213,7 @@ class DevicePool:
         Returns without blocking on the result; blocks only when the
         chosen replica already has ``inflight_depth`` batches in flight
         (backpressure, recorded as queue wait)."""
-        rep = self._pick_replica()
+        rep, depth = self._pick_replica()
         mv = np.asarray(model_valid)
         host_blobs = {k: v for k, v in blobs.items() if v is not None}
         try:
@@ -216,7 +224,7 @@ class DevicePool:
             self._mark_failed(rep)
             raise
         return PoolToken(out, rep.idx, host_blobs, spec, params, mv,
-                         time.perf_counter())
+                         time.perf_counter(), inflight_at_dispatch=depth)
 
     # ------------------------------------------------------------ completion
     def _mark_failed(self, rep: "_Replica") -> None:

@@ -121,17 +121,22 @@ class AssemblerStage:
 
     # --------------------------------------------------------------- submit
     def submit(self, records: Sequence[Mapping[str, Any]],
-               now: Optional[float] = None) -> AssembledHandle:
+               now: Optional[float] = None,
+               trace: Optional[Any] = None) -> AssembledHandle:
         """Enqueue one microbatch for background assembly + dispatch.
 
         Blocks when ``depth`` batches are already queued (backpressure);
         the returned handle resolves to the PendingScore in FIFO order.
+        ``trace`` (obs.tracing.TraceBatch) rides the queue item so the
+        stage thread's assemble/pack/dispatch marks land on the batch
+        that is actually being assembled — trace↔batch attachment is by
+        object identity, immune to thread interleaving.
         """
         if self._closed:
             raise RuntimeError("assembler stage is closed")
         self._ensure_started()
         handle = AssembledHandle()
-        self._q.put((list(records), now, handle))
+        self._q.put((list(records), now, handle, trace))
         return handle
 
     def finalize(self, handle: AssembledHandle,
@@ -147,13 +152,15 @@ class AssemblerStage:
             item = self._q.get()
             if item is None:
                 return
-            records, now, handle = item
+            records, now, handle, trace = item
             t0 = time.perf_counter()
             try:
                 with self.lock:
+                    if trace is not None:
+                        trace.mark("assemble")
                     batch = self.scorer.assemble(records, now)
                     pending = self.scorer.dispatch_assembled(
-                        batch, records, t0=t0)
+                        batch, records, t0=t0, trace=trace)
             except BaseException as e:  # noqa: BLE001 — surfaces at result()
                 # account busy time BEFORE resolving the handle: a caller
                 # that reads busy_s right after the last result() must see

@@ -96,6 +96,11 @@ class PendingScore:
     # resolves through DevicePool.wait (retry-on-replica-failure) instead
     # of a plain device_get. None = single-device path.
     pool_token: Optional[Any] = None
+    # tracing plane (obs/tracing.py): the microbatch's TraceBatch carrier.
+    # The scorer marks assemble/pack/dispatch/device_wait/finalize on it;
+    # the owner (stream job / serving app) finishes it after fan-out.
+    # None = tracing off (the default no-op fast path).
+    trace: Optional[Any] = None
 
 
 class _EntityIndex:
@@ -664,7 +669,8 @@ class FraudScorer:
 
     # ----------------------------------------------------------------- scoring
     def dispatch(self, records: Sequence[Mapping[str, Any]],
-                 now: Optional[float] = None) -> "PendingScore":
+                 now: Optional[float] = None,
+                 trace: Optional[Any] = None) -> "PendingScore":
         """Assemble + launch the fused device program WITHOUT blocking.
 
         JAX dispatch is async: the returned ``PendingScore`` holds device
@@ -674,6 +680,9 @@ class FraudScorer:
         This is the in-path version of stream/microbatch.DoubleBufferedScorer
         — host→device pipelining, the reference operator pipeline's analog
         (SURVEY.md §2.8).
+
+        ``trace`` (an obs.tracing.TraceBatch) collects batch-granular
+        stage marks; None — the default — costs one branch per stage.
         """
         t0 = time.perf_counter()
         n = len(records)
@@ -681,18 +690,23 @@ class FraudScorer:
             return PendingScore(records=[], n=0, out=None,
                                 features=self.last_features[:0],
                                 dispatch_ms=0.0)
+        if trace is not None:
+            trace.mark("assemble")
         batch = self.assemble(records, now)
-        return self.dispatch_assembled(batch, records, t0=t0)
+        return self.dispatch_assembled(batch, records, t0=t0, trace=trace)
 
     def dispatch_assembled(self, batch: ScoreBatch,
                            records: Sequence[Mapping[str, Any]],
-                           t0: Optional[float] = None) -> "PendingScore":
+                           t0: Optional[float] = None,
+                           trace: Optional[Any] = None) -> "PendingScore":
         """Pad + pack + launch an already-assembled batch (the device half
         of ``dispatch``). Split out so the overlapped assembler stage
         (scoring/host_pipeline.py) can run ``assemble`` on its own thread
         and hand the result here."""
         if t0 is None:
             t0 = time.perf_counter()
+        if trace is not None:
+            trace.mark("pack")
         t_pack = time.perf_counter()
         n = len(records)
         size = bucket_for(n, BATCH_BUCKETS,
@@ -719,6 +733,8 @@ class FraudScorer:
             )
         blobs, spec = pack_tree(padded)
         self.spans.record("pack", time.perf_counter() - t_pack)
+        if trace is not None:
+            trace.mark("dispatch")
         t_disp = time.perf_counter()
 
         mv = self.effective_model_valid()
@@ -731,6 +747,12 @@ class FraudScorer:
             token = self._pool.dispatch_packed(
                 blobs, spec, self.ensemble_params, mv)
             out = token.out
+            if trace is not None:
+                # which replica got the batch, and how deep its queue was
+                # at dispatch — the tail-attribution metadata the ISSUE's
+                # "where did the p99 go" question needs
+                trace.annotate(replica=token.replica_idx,
+                               inflight_depth=token.inflight_at_dispatch)
         else:
             sharded = shard_batch(self.mesh, blobs)
             out = score_fused_packed(
@@ -750,11 +772,15 @@ class FraudScorer:
             except AttributeError:  # backend without async copy support
                 pass
         self.spans.record("dispatch", time.perf_counter() - t_disp)
+        if trace is not None:
+            # launch returned: from the transaction's point of view the
+            # device residency (compute + any pipeline dwell) starts here
+            trace.mark("device_wait")
         return PendingScore(records=list(records), n=n, out=out,
                             features=np.asarray(batch.features),
                             dispatch_ms=(time.perf_counter() - t0) * 1000.0,
                             model_valid=mv, rules_only=rules_only,
-                            pool_token=token)
+                            pool_token=token, trace=trace)
 
     def finalize(self, pending: "PendingScore", now: Optional[float] = None,
                  lock=None) -> List[Dict[str, Any]]:
@@ -776,6 +802,10 @@ class FraudScorer:
         else:
             out = jax.device_get(pending.out)  # blocks until device is done
         self.spans.record("device_wait", time.perf_counter() - t_fin)
+        if pending.trace is not None:
+            # result in hand: everything after this mark (response build,
+            # state write-back, the owner's fan-out) is the finalize stage
+            pending.trace.mark("finalize")
         # processing time = assemble/dispatch + device wait; excludes any
         # pipeline queue wait between dispatch() returning and this call
         elapsed_ms = (pending.dispatch_ms

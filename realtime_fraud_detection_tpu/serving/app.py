@@ -103,12 +103,21 @@ class ServingApp:
 
             self.pool = DevicePool(self.scorer,
                                    inflight_depth=sc.inflight_depth)
+        # tracing plane (obs/tracing.py): per-transaction flight recorder
+        # + /latency/breakdown + /slo. Constructed only when enabled —
+        # the scoring path's no-op cost is one `is None` branch per batch.
+        self.tracer = None
+        if self.config.tracing.enabled:
+            from realtime_fraud_detection_tpu.obs.tracing import Tracer
+
+            self.tracer = Tracer(self.config.tracing)
         two_phase = sc.overlap_assembly or self.pool is not None
         self.batcher = RequestMicrobatcher(
             self._score_batch_sync,
             max_batch=sc.microbatch_max_size,
             deadline_ms=sc.microbatch_deadline_ms,
             budget=self.qos.budget if self.config.qos.enabled else None,
+            tracer=self.tracer,
             # two-phase pipelined scoring (serving.overlap_assembly): the
             # drain task dispatches batch N+1 (cache check + assembly +
             # device launch) while batch N still waits on the device in its
@@ -156,7 +165,7 @@ class ServingApp:
         self._register_routes()
 
     # --------------------------------------------------------------- scoring
-    def _score_batch_sync(self, txns) -> List[Dict[str, Any]]:
+    def _score_batch_sync(self, txns, trace=None) -> List[Dict[str, Any]]:
         """Runs in an executor thread: device call + obs write-back.
 
         The score lock is held for host-state mutation only (assembly at
@@ -164,9 +173,10 @@ class ServingApp:
         so a concurrent caller assembles its batch while this one's compute
         is in flight (the double-buffered serving path, VERDICT r1 item 6).
         """
-        return self._finalize_batch_sync(self._dispatch_batch_sync(txns))
+        return self._finalize_batch_sync(self._dispatch_batch_sync(txns,
+                                                                   trace))
 
-    def _dispatch_batch_sync(self, txns) -> tuple:
+    def _dispatch_batch_sync(self, txns, trace=None) -> tuple:
         """Pipeline stage 1 (executor thread): prediction-cache lookup +
         assemble + device launch, WITHOUT blocking on the result. The
         two-phase microbatcher (serving.overlap_assembly) calls this for
@@ -186,27 +196,52 @@ class ServingApp:
                         cached[i] = hit            # deep copy from the cache
             if cached:
                 to_score = [t for i, t in enumerate(txns) if i not in cached]
+        if trace is not None and cached:
+            # cache hits never reach the device: close their traces with
+            # the `cached` terminal and keep only the scored contexts on
+            # the batch carrier (contexts align with txns by queue order)
+            kept = []
+            for i, c in enumerate(trace.contexts):
+                if i in cached:
+                    self.tracer.finish_terminal(c, "cached")
+                else:
+                    kept.append(c)
+            trace.contexts = kept
         try:
             pending = None
             if to_score:
                 with self._score_lock:
-                    pending = self.scorer.dispatch(to_score)
+                    pending = self.scorer.dispatch(to_score, trace=trace)
         except Exception:
             self.metrics.record_error("score")
+            self._close_trace_error(trace)
             raise
-        return (t0, txns, to_score, cached, pending)
+        return (t0, txns, to_score, cached, pending, trace)
+
+    def _close_trace_error(self, trace) -> None:
+        """Close every open context on a failed batch with the `error`
+        terminal — the waiters got the exception, but the flight
+        recorder must still see the (worst-latency) failing
+        transactions, exactly as the stream job records them. Never a
+        silent gap."""
+        if trace is None or self.tracer is None:
+            return
+        for c in trace.contexts:
+            self.tracer.finish_terminal(c, "error")
+        trace.contexts = []
 
     def _finalize_batch_sync(self, ctx: tuple) -> List[Dict[str, Any]]:
         """Pipeline stage 2 (executor thread): block on the device result,
         then run the obs/experiment/cache tail and reassemble request
         order."""
-        t0, txns, to_score, cached, pending = ctx
+        t0, txns, to_score, cached, pending, trace = ctx
         cache = self.prediction_cache
         try:
             fresh = (self.scorer.finalize(pending, lock=self._score_lock)
                      if pending is not None else [])
         except Exception:
             self.metrics.record_error("score")
+            self._close_trace_error(trace)
             raise
         dt = time.perf_counter() - t0
         # batch metrics count the same population as per-prediction metrics:
@@ -252,6 +287,19 @@ class ServingApp:
                               else None))
                 self.feedback.check_trigger()
             self._maybe_react()
+        if trace is not None and self.tracer is not None:
+            # emit: the batch's waiters resolve right after this returns.
+            # Closing here also feeds the SLO window; the burn gate is an
+            # extra, hysteresis-guarded degradation signal on top of the
+            # backlog ladder.
+            self.tracer.finish_batch(trace)
+            if self.qos.enabled:
+                ts = self.config.tracing
+                self.qos.observe_slo_burn(
+                    self.tracer.slo.burn_rate(ts.slo_fast_window_s),
+                    threshold=ts.slo_burn_threshold,
+                    patience=ts.slo_gate_patience,
+                    up_patience=ts.slo_gate_up_patience)
         # reassemble in request order
         if cached:
             results, it_fresh = [], iter(fresh)
@@ -338,6 +386,8 @@ class ServingApp:
         r("POST", "/qos", self._qos_configure)
         r("POST", "/labels", self._ingest_labels)
         r("GET", "/quality/live", self._quality_live)
+        r("GET", "/latency/breakdown", self._latency_breakdown)
+        r("GET", "/slo", self._slo_status)
 
     def _admit(self, n: int) -> None:
         limit = self.config.serving.max_concurrent_predictions
@@ -457,6 +507,8 @@ class ServingApp:
         self.metrics.sync_host_stats(self.scorer.host_stats())
         if self.pool is not None:
             self.metrics.sync_device_pool(self.pool.stats())
+        if self.tracer is not None:
+            self.metrics.sync_tracing(self.tracer.snapshot())
         if self.config.feedback.enabled:
             with self._score_lock:
                 snap = self.feedback.snapshot()
@@ -659,6 +711,30 @@ class ServingApp:
         executor thread mutates the plane's windows under the same lock."""
         with self._score_lock:
             return 200, self.feedback.snapshot()
+
+    async def _latency_breakdown(self, body, query) -> Tuple[int, Any]:
+        """Critical-path decomposition of the captured trace window:
+        additive per-stage contributions to the p50/p95/p99 end-to-end
+        latency with the dominant stage flagged, plus the slowest-N
+        exemplar trace ids (obs/tracing.py breakdown)."""
+        if self.tracer is None:
+            return 200, {"enabled": False, "n": 0,
+                         "hint": "start with --trace or "
+                                 "config.tracing.enabled"}
+        return 200, self.tracer.breakdown()
+
+    async def _slo_status(self, body, query) -> Tuple[int, Any]:
+        """SLO burn-rate status: objective, fast/slow-window violation
+        fractions + burn rates, and the QoS gate the burn signal feeds."""
+        if self.tracer is None:
+            return 200, {"enabled": False}
+        payload = self.tracer.slo.snapshot()
+        payload["enabled"] = True
+        payload["qos_gate"] = {
+            "engaged": self.qos.slo_engaged,
+            "threshold": self.config.tracing.slo_burn_threshold,
+        }
+        return 200, payload
 
     async def _drift(self, body, query) -> Tuple[int, Any]:
         rep = self.drift.report()

@@ -50,6 +50,7 @@ class RequestMicrobatcher:
         dispatch_fn: Optional[Callable[[Sequence[Mapping[str, Any]]], Any]] = None,
         finalize_fn: Optional[Callable[[Any], List[Dict[str, Any]]]] = None,
         pipeline_depth: int = 2,
+        tracer=None,
     ):
         self.score_fn = score_fn
         self.max_batch = max_batch
@@ -57,6 +58,14 @@ class RequestMicrobatcher:
         # optional qos.LatencyBudget: per-request enqueue timestamps bound
         # the close deadline by the oldest waiter's remaining budget
         self.budget = budget
+        # optional obs.tracing.Tracer: each drained batch gets a
+        # TraceBatch whose per-request admission time is the enqueue
+        # timestamp (same time.monotonic base as the tracer's clock), so
+        # the ``queue`` stage measures the real microbatch queue wait.
+        # The trace is passed as a second argument to score_fn/dispatch_fn
+        # ONLY when a tracer is attached — existing single-argument
+        # callables are untouched.
+        self.tracer = tracer
         # two-phase pipelined mode: with dispatch_fn + finalize_fn, the
         # drain task runs dispatch (assembly + device launch) inline and
         # hands the blocking finalize to its own ordered task, so batch
@@ -173,16 +182,31 @@ class RequestMicrobatcher:
             except Exception:  # noqa: BLE001 — waiters got the exception
                 pass
 
+    def _trace_for(self, batch):
+        """Open a TraceBatch for a drained batch (None when untraced):
+        admission = the request's enqueue instant, so queue wait is real."""
+        if self.tracer is None or not self.tracer.enabled:
+            return None
+        return self.tracer.batch(
+            [self.tracer.begin(str(t.get("transaction_id", "")),
+                               t_admit=ts) for t, _, ts in batch],
+            batch_size=len(batch))
+
     async def _score(self, loop, batch) -> None:
         if self.dispatch_fn is not None:
             await self._score_pipelined(loop, batch)
             return
         txns = [t for t, _, _ in batch]
         futs = [f for _, f, _ in batch]
+        trace = self._trace_for(batch)
         try:
             # device work off the event loop; one fused program per batch
-            results = await loop.run_in_executor(
-                None, self.score_fn, txns)
+            if trace is not None:
+                results = await loop.run_in_executor(
+                    None, self.score_fn, txns, trace)
+            else:
+                results = await loop.run_in_executor(
+                    None, self.score_fn, txns)
         except Exception as e:                   # noqa: BLE001
             for f in futs:
                 if not f.done():
@@ -205,8 +229,14 @@ class RequestMicrobatcher:
         finalize behind its predecessor."""
         txns = [t for t, _, _ in batch]
         futs = [f for _, f, _ in batch]
+        trace = self._trace_for(batch)
         try:
-            ctx = await loop.run_in_executor(None, self.dispatch_fn, txns)
+            if trace is not None:
+                ctx = await loop.run_in_executor(
+                    None, self.dispatch_fn, txns, trace)
+            else:
+                ctx = await loop.run_in_executor(
+                    None, self.dispatch_fn, txns)
         except Exception as e:                   # noqa: BLE001
             for f in futs:
                 if not f.done():

@@ -73,12 +73,18 @@ class IngressGateway:
     def __init__(self, broker: Any, topic: str,
                  key_fn: Optional[Callable[[Mapping[str, Any]], str]] = None,
                  capacity: int = 8192, max_batch: int = 512,
-                 max_delay_ms: float = 5.0):
+                 max_delay_ms: float = 5.0, stamp_ingest: bool = False):
         self.broker = broker
         self.topic = topic
         self.key_fn = key_fn or (lambda r: str(r.get("user_id", "")))
         self.max_batch = max_batch
         self.max_delay_ms = max_delay_ms
+        # tracing support: stamp each submitted txn with the wall-clock
+        # instant it entered THIS process (``ingest_ts``), so the tracing
+        # plane's ``ingest`` stage covers the gateway ring + sender +
+        # broker hop, not just broker-to-admission. Off by default — the
+        # stamp adds a field to every produced record.
+        self.stamp_ingest = bool(stamp_ingest)
         self.sent = 0
         self.dropped = 0
         self.native = False
@@ -111,6 +117,9 @@ class IngressGateway:
         """Lock-free enqueue from any thread. False == ring full —
         backpressure, NOT a drop: the caller sheds or retries, and the
         ``dropped`` counter only ever counts records actually lost."""
+        if self.stamp_ingest:
+            txn = dict(txn)
+            txn["ingest_ts"] = time.time()
         payload = json.dumps(txn, separators=(",", ":")).encode()
         if self._slot_bytes is not None and len(payload) > self._slot_bytes:
             # oversized for a ring slot: drain what's queued first so this

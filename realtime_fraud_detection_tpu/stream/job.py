@@ -100,6 +100,12 @@ class JobConfig:
     # assembled feature rows into the label join / drift monitor) and
     # whose labels topic it drains in the run loops. None = off.
     feedback: Optional[Any] = None       # feedback.FeedbackPlane
+    # tracing plane (obs/tracing.py): a TracingSettings (or a live Tracer)
+    # — every admitted transaction gets a trace context riding the batch
+    # through dispatch/completion into the flight recorder; sheds get a
+    # terminal `shed` trace. None or enabled=False = off, and the scoring
+    # path pays one `is None` branch per batch (the measured no-op path).
+    tracing: Optional[Any] = None        # utils.config.TracingSettings|Tracer
     labels_topic: str = T.LABELS
     # topic names (reference JobConfig.java topic parameters); defaults are
     # the §2.5 contract (stream/topics.py) — overridable per deployment,
@@ -134,6 +140,8 @@ class _BatchCtx:
     # explicit score-with-reason on the predictions topic at completion —
     # a shed is a recorded decision, never a silent drop.
     shed: List[tuple] = dataclasses.field(default_factory=list)
+    # tracing plane: this batch's TraceBatch carrier (None = tracing off)
+    trace: Optional[Any] = None
 
 
 class StreamJob:
@@ -207,6 +215,18 @@ class StreamJob:
 
             self._stage = AssemblerStage(
                 scorer, depth=max(1, self.config.pipeline_depth))
+        # tracing plane: per-transaction flight recorder + SLO burn rate.
+        # A live Tracer is adopted (the drills pass a virtual-clock one);
+        # TracingSettings with enabled=True constructs one here.
+        self.tracer = None
+        tr = self.config.tracing
+        if tr is not None:
+            from realtime_fraud_detection_tpu.obs.tracing import Tracer
+
+            if isinstance(tr, Tracer):
+                self.tracer = tr if tr.enabled else None
+            elif getattr(tr, "enabled", False):
+                self.tracer = Tracer(tr)
         self.counters: Dict[str, int] = {
             "scored": 0, "alerts": 0, "batches": 0, "duplicates_skipped": 0,
             "errors": 0, "shed": 0,
@@ -248,8 +268,27 @@ class StreamJob:
         invalid: List[tuple] = []
         cached_dups: List[tuple] = []
         shed: List[tuple] = []
+        trace_ctxs: List[Any] = []
+        tracer = self.tracer
         batch_ids: set = set()
         t_adm = now if now is not None else time.time()
+
+        def _ingest_lag(rec: Record) -> float:
+            # upstream-of-admission lag: gateway ingest stamp when present
+            # (IngressGateway stamp_ingest), else the broker produce
+            # timestamp — wall-minus-wall (or virtual-minus-virtual in the
+            # drills), never mixed with the tracer's monotonic base
+            src = None
+            if isinstance(rec.value, dict):
+                src = rec.value.get("ingest_ts")
+            if src is None:
+                src = rec.timestamp
+            try:
+                return max(0.0, t_adm - float(src)) if src is not None \
+                    else 0.0
+            except (TypeError, ValueError):
+                return 0.0
+
         for r in records:
             txn, errors = sanitize_for_stream(r.value)
             if errors:
@@ -285,9 +324,19 @@ class StreamJob:
                     self.counters["shed"] += 1
                     shed.append((dataclasses.replace(r, value=txn),
                                  decision))
+                    if tracer is not None:
+                        # a shed is a recorded terminal trace, not a gap
+                        tracer.finish_terminal(
+                            tracer.begin(txn_id,
+                                         ingest_lag_s=_ingest_lag(r)),
+                            "shed", reason=decision.reason,
+                            priority=decision.priority)
                     continue
             batch_ids.add(txn_id)
             fresh.append(dataclasses.replace(r, value=txn))
+            if tracer is not None:
+                trace_ctxs.append(
+                    tracer.begin(txn_id, ingest_lag_s=_ingest_lag(r)))
         positions = self.consumer.snapshot_positions()
         if self.qos is not None:
             # backlog signal, one ladder observation per dispatched
@@ -308,24 +357,37 @@ class StreamJob:
         if not fresh:
             return _BatchCtx([], set(), None, positions, now, invalid,
                              cached_dups, shed)
+        trace = None
+        if tracer is not None:
+            trace = tracer.batch(
+                trace_ctxs, batch_size=len(fresh),
+                close_reason=self.assembler.last_close_reason)
         pending = None
         try:
+            # the trace kwarg is passed ONLY when tracing is live: drills
+            # and tests drive this job with duck-typed scorer stand-ins
+            # whose dispatch() may not know the parameter, and an
+            # unexpected-kwarg TypeError here would silently take the
+            # whole-batch degradation path
+            kw = {"trace": trace} if trace is not None else {}
             if self._stage is not None:
                 # background assembly: the handle resolves to a
                 # PendingScore at completion; errors surface there and take
-                # the same whole-batch degradation path
+                # the same whole-batch degradation path. The trace rides
+                # the queue item, so the stage thread's marks land on the
+                # batch they belong to (identity, not timing).
                 pending = self._stage.submit([r.value for r in fresh],
-                                             now=now)
+                                             now=now, **kw)
             else:
                 pending = self.scorer.dispatch([r.value for r in fresh],
-                                               now=now)
+                                               now=now, **kw)
         except Exception:
             # whole-batch degradation fallback: score 0.5, REVIEW, keep the
             # stream alive; counted at completion
             pass
         self._inflight_ids |= batch_ids
         return _BatchCtx(fresh, batch_ids, pending, positions, now, invalid,
-                         cached_dups, shed)
+                         cached_dups, shed, trace)
 
     def complete_batch(self, ctx: "_BatchCtx",
                        now: Optional[float] = None) -> List[Dict[str, Any]]:
@@ -398,6 +460,23 @@ class StreamJob:
             self._emit_cached_dups(ctx)
             out = invalid_results + self._fan_out(
                 ctx, fresh, results, feats, scored_ok, now)
+            if ctx.trace is not None and self.tracer is not None:
+                # emit complete: close every trace in the batch (the
+                # per-txn e2e/SLO observation happens here), then consult
+                # the SLO burn gate — latency can burn the error budget
+                # without the backlog signal ever tripping
+                self.tracer.finish_batch(
+                    ctx.trace, terminal="scored" if scored_ok else "error")
+                if self.qos is not None:
+                    # burn rate and trace completion share the tracer's
+                    # clock (virtual in the drills), so no ``now`` is
+                    # passed — one time base end to end
+                    ts = self.tracer.settings
+                    self.qos.observe_slo_burn(
+                        self.tracer.slo.burn_rate(ts.slo_fast_window_s),
+                        threshold=ts.slo_burn_threshold,
+                        patience=ts.slo_gate_patience,
+                        up_patience=ts.slo_gate_up_patience)
             if self.feedback is not None and scored_ok:
                 # feed the label join with exactly what was emitted, plus
                 # the assembled feature rows (the retrain corpus), then

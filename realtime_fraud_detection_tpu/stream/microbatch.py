@@ -56,6 +56,11 @@ class MicrobatchAssembler:
         self._oldest_event_ts: Optional[float] = None
         self.batches_emitted = 0
         self.records_emitted = 0
+        # why the LAST batch closed (size | deadline | budget | timeout |
+        # flush) — tail-attribution metadata for the tracing plane: a
+        # deadline-closed size-1 batch and a full size-256 batch have very
+        # different per-txn cost profiles
+        self.last_close_reason: Optional[str] = None
 
     def _deadline_passed(self) -> bool:
         return (
@@ -95,19 +100,21 @@ class MicrobatchAssembler:
                         else min(self._oldest_event_ts, ts))
                 self._pending.extend(got)
 
-            if len(self._pending) >= self.max_batch or (
-                self._pending
-                and (self._deadline_passed() or self._budget_low())
-            ):
-                return self._emit()
+            if len(self._pending) >= self.max_batch:
+                return self._emit("size")
+            if self._pending and self._budget_low():
+                return self._emit("budget")
+            if self._pending and self._deadline_passed():
+                return self._emit("deadline")
 
             if not block:
                 return []
             if timeout_s is not None and self.clock() - wait_start >= timeout_s:
-                return self._emit() if self._pending else []
+                return self._emit("timeout") if self._pending else []
             time.sleep(self.idle_sleep_s)
 
-    def _emit(self) -> List[Record]:
+    def _emit(self, reason: str = "size") -> List[Record]:
+        self.last_close_reason = reason
         batch, self._pending = self._pending[: self.max_batch], self._pending[self.max_batch:]
         self._first_ts = self.clock() if self._pending else None
         if self.budget is not None and self._pending:
@@ -122,7 +129,7 @@ class MicrobatchAssembler:
 
     def flush(self) -> List[Record]:
         """Close and return whatever is pending (drain-on-shutdown)."""
-        return self._emit() if self._pending else []
+        return self._emit("flush") if self._pending else []
 
 
 class DoubleBufferedScorer:

@@ -224,6 +224,68 @@ class QosSettings:
 
 
 @dataclass
+class TracingSettings:
+    """End-to-end transaction tracing plane knobs (obs/tracing.py):
+    flight recorder, critical-path analyzer, SLO burn-rate tracking.
+
+    Disabled by default — the plane is opt-in per deployment (``serve
+    --trace``, ``run-job --trace``, or config/JSON overlay) with a
+    measured-no-op fast path when off (one ``is None`` branch per batch
+    on the scoring paths; ``rtfd trace-drill`` pins the enabled-path
+    overhead bound too). All knobs are host state; nothing recompiles.
+    """
+
+    enabled: bool = False
+    # flight recorder: ring of the most recent completed traces, plus the
+    # slowest-N kept verbatim (the tail exemplars Chrome-trace export and
+    # /latency/breakdown surface regardless of ring churn)
+    ring_size: int = 4096
+    slowest_n: int = 32
+    # SLO objective: objective_frac of scored transactions complete under
+    # objective_ms, evaluated over a fast and a slow window (the standard
+    # multi-window burn-rate pair); bucket_s is the counting granularity
+    slo_objective_ms: float = 20.0
+    slo_objective_frac: float = 0.99
+    slo_fast_window_s: float = 3600.0
+    slo_slow_window_s: float = 21600.0
+    slo_bucket_s: float = 60.0
+    # QoS consultation: a fast-window burn rate above slo_burn_threshold
+    # for slo_gate_patience consecutive observations engages an extra
+    # degradation floor (>= ladder rung 1); recovery needs
+    # slo_gate_up_patience consecutive under-threshold observations —
+    # the same asymmetric hysteresis discipline as the backlog ladder
+    slo_burn_threshold: float = 2.0
+    slo_gate_patience: int = 3
+    slo_gate_up_patience: int = 12
+
+    def validate(self) -> None:
+        if not 0.0 < self.slo_objective_frac < 1.0:
+            raise ValueError(
+                f"tracing.slo_objective_frac must be in (0, 1), got "
+                f"{self.slo_objective_frac}")
+        if self.slo_objective_ms <= 0 or self.ring_size < 16 \
+                or self.slowest_n < 1:
+            raise ValueError(
+                "tracing requires slo_objective_ms > 0, ring_size >= 16 "
+                "and slowest_n >= 1")
+        if not (0 < self.slo_bucket_s <= self.slo_fast_window_s
+                <= self.slo_slow_window_s):
+            # a fast window longer than the slow one would invert the
+            # burn-alerting pair; a bucket wider than the fast window
+            # would make its burn rate a single stale cell
+            raise ValueError(
+                f"tracing SLO windows must satisfy 0 < bucket_s <= "
+                f"fast_window_s <= slow_window_s, got "
+                f"bucket={self.slo_bucket_s} fast={self.slo_fast_window_s} "
+                f"slow={self.slo_slow_window_s}")
+        if self.slo_burn_threshold <= 0 or self.slo_gate_patience < 1 \
+                or self.slo_gate_up_patience < 1:
+            raise ValueError(
+                "tracing SLO gate requires burn_threshold > 0 and "
+                "patience/up_patience >= 1")
+
+
+@dataclass
 class FeedbackSettings:
     """Continuous-learning plane knobs (feedback/): label join, prequential
     evaluation, retrain policy, promotion gate. Disabled by default — the
@@ -401,6 +463,7 @@ class Config:
     monitoring: MonitoringConfig = field(default_factory=MonitoringConfig)
     qos: QosSettings = field(default_factory=QosSettings)
     feedback: FeedbackSettings = field(default_factory=FeedbackSettings)
+    tracing: TracingSettings = field(default_factory=TracingSettings)
 
     def __post_init__(self) -> None:
         self._apply_env()
@@ -576,6 +639,7 @@ class Config:
                 f"decline={e.decline_threshold}")
         self.qos.validate()
         self.feedback.validate()
+        self.tracing.validate()
 
 
 def _merge_dataclass(obj: Any, data: Dict[str, Any]) -> None:

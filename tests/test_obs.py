@@ -1,4 +1,6 @@
-"""Observability plane: metrics registry, drift monitor, logs, span timer."""
+"""Observability plane: metrics registry, drift monitor, logs, span timer,
+and the per-transaction tracing plane (flight recorder, critical-path
+analyzer, SLO burn rate, Prometheus mirror, overhead guard)."""
 
 import json
 import logging
@@ -12,9 +14,20 @@ from realtime_fraud_detection_tpu.obs import (
     JsonFormatter,
     MetricsCollector,
     Registry,
+    SloTracker,
     SpanTimer,
+    Tracer,
     log_prediction_result,
 )
+from realtime_fraud_detection_tpu.utils.config import TracingSettings
+
+
+def _vclock_tracer(clock, **kw):
+    defaults = dict(enabled=True, ring_size=256, slowest_n=4,
+                    slo_objective_ms=20.0, slo_fast_window_s=1.0,
+                    slo_slow_window_s=4.0, slo_bucket_s=0.05)
+    defaults.update(kw)
+    return Tracer(TracingSettings(**defaults), clock=lambda: clock[0])
 
 
 class TestRegistry:
@@ -229,3 +242,372 @@ class TestSpanTimer:
         assert st["total_s"] == pytest.approx(0.013)
         timer.reset()
         assert timer.stats() == {}
+
+    def test_percentiles_interpolate(self):
+        """Satellite: p50/p99 interpolate between order statistics —
+        raw index selection made p99 on small n simply the max."""
+        timer = SpanTimer()
+        for ms in range(1, 101):            # 1..100 ms
+            timer.record("s", ms / 1e3)
+        st = timer.stats("s")["s"]
+        assert st["p50_ms"] == pytest.approx(50.5)       # numpy default
+        assert st["p99_ms"] == pytest.approx(99.01)
+        assert st["p99_ms"] < st["max_ms"]               # not just the max
+        np.testing.assert_allclose(
+            [st["p50_ms"], st["p99_ms"]],
+            np.percentile(np.arange(1.0, 101.0), [50, 99]))
+
+    def test_small_n_p99_not_max(self):
+        timer = SpanTimer()
+        for ms in (1.0, 2.0, 100.0):
+            timer.record("s", ms / 1e3)
+        st = timer.stats("s")["s"]
+        assert st["p99_ms"] < 100.0
+        assert st["p99_ms"] == pytest.approx(
+            np.percentile([1.0, 2.0, 100.0], 99))
+
+
+class TestTracer:
+    def _scored_batch(self, tracer, clock, txn_ids, stage_costs_ms,
+                      ingest_lag_s=0.0):
+        """Drive one batch through the mark protocol on a virtual clock."""
+        ctxs = [tracer.begin(t, ingest_lag_s=ingest_lag_s)
+                for t in txn_ids]
+        tb = tracer.batch(ctxs, batch_size=len(txn_ids))
+        for stage in ("assemble", "pack", "dispatch", "device_wait",
+                      "finalize"):
+            tb.mark(stage)
+            clock[0] += stage_costs_ms.get(stage, 0.0) / 1e3
+        tracer.finish_batch(tb)
+        return tb
+
+    def test_stages_additive_and_recorded(self):
+        clock = [0.0]
+        tracer = _vclock_tracer(clock)
+        costs = {"assemble": 3.0, "pack": 0.5, "dispatch": 0.5,
+                 "device_wait": 5.0, "finalize": 1.0}
+        self._scored_batch(tracer, clock, ["a", "b"], costs,
+                           ingest_lag_s=0.002)
+        traces = tracer.traces(terminal="scored")
+        assert len(traces) == 2
+        for t in traces:
+            # consecutive-mark stages partition e2e exactly
+            assert sum(t.stages.values()) == pytest.approx(t.e2e_ms)
+            assert t.stages["ingest"] == pytest.approx(2.0)
+            for stage, ms in costs.items():
+                assert t.stages[stage] == pytest.approx(ms)
+        assert tracer.counters["completed"] == 2
+
+    def test_disabled_is_noop(self):
+        tracer = Tracer(TracingSettings(enabled=False))
+        assert tracer.begin("x") is None
+        assert tracer.batch([None]) is None
+        tracer.finish_batch(None)                 # must not raise
+        tracer.finish_terminal(None, "shed")
+        assert tracer.traces() == []
+
+    def test_shed_terminal_recorded(self):
+        clock = [0.0]
+        tracer = _vclock_tracer(clock)
+        tracer.finish_terminal(tracer.begin("s1"), "shed",
+                               reason="no_tokens")
+        traces = tracer.traces(terminal="shed")
+        assert len(traces) == 1
+        assert traces[0].meta["reason"] == "no_tokens"
+        assert tracer.counters["shed"] == 1
+        # shed traces never pollute the scored attribution or the SLO
+        assert tracer.breakdown()["n"] == 0
+        assert tracer.slo.observations_total == 0
+
+    def test_slowest_survive_ring_eviction(self):
+        clock = [0.0]
+        tracer = _vclock_tracer(clock, ring_size=16, slowest_n=2)
+        # one slow outlier, then enough fast traces to evict it from the
+        # ring — the exemplar store must still hold it verbatim
+        self._scored_batch(tracer, clock, ["slow"],
+                           {"device_wait": 500.0})
+        for i in range(40):
+            self._scored_batch(tracer, clock, [f"f{i}"],
+                               {"device_wait": 1.0})
+        ring_ids = {t.txn_id for t in tracer.traces()}
+        assert "slow" not in ring_ids                 # evicted from ring
+        slowest = tracer.slowest()
+        assert slowest[0].txn_id == "slow"            # kept verbatim
+        assert slowest[0].e2e_ms == pytest.approx(500.0)
+
+    def test_breakdown_names_dominant_stage(self):
+        clock = [0.0]
+        tracer = _vclock_tracer(clock)
+        for i in range(20):
+            self._scored_batch(tracer, clock, [f"t{i}"],
+                               {"assemble": 1.0, "device_wait": 12.0,
+                                "finalize": 0.5})
+        bd = tracer.breakdown()
+        assert bd["n"] == 20
+        for q in ("p50", "p95", "p99"):
+            assert bd["quantiles"][q]["dominant_stage"] == "device_wait"
+            stage_ms = bd["quantiles"][q]["stage_ms"]
+            assert sum(stage_ms.values()) == pytest.approx(
+                bd["quantiles"][q]["e2e_ms"], rel=0.05)
+        assert bd["exemplars"]
+
+    def test_chrome_export_structure(self):
+        clock = [0.0]
+        tracer = _vclock_tracer(clock)
+        self._scored_batch(tracer, clock, ["c1", "c2"],
+                           {"assemble": 2.0, "device_wait": 3.0})
+        payload = tracer.export_chrome_trace()
+        events = payload["traceEvents"]
+        assert len(events) == 2 * 6        # 2 txns x 6 recorded stages
+        assert {e["ph"] for e in events} == {"X"}
+        names = {e["name"] for e in events}
+        assert {"queue", "assemble", "device_wait"} <= names
+        args = events[0]["args"]
+        assert args["trace_id"] and args["txn_id"]
+        json.dumps(payload)                # must be JSON-serializable
+
+    def test_reset_clears_window_not_counters(self):
+        clock = [0.0]
+        tracer = _vclock_tracer(clock)
+        self._scored_batch(tracer, clock, ["r1"], {"assemble": 1.0})
+        tracer.reset()
+        assert tracer.traces() == []
+        assert tracer.counters["completed"] == 1
+
+
+class TestSloTracker:
+    def test_burn_rate_math(self):
+        clock = [0.0]
+        slo = SloTracker(objective_ms=20.0, objective_frac=0.99,
+                         fast_window_s=1.0, slow_window_s=4.0,
+                         bucket_s=0.05, clock=lambda: clock[0])
+        for i in range(100):
+            slo.record(5.0, now=clock[0])         # within objective
+        slo.record(50.0, now=clock[0])            # one violation
+        # violation frac 1/101 over a 1% budget -> burn ~0.99
+        assert slo.burn_rate(1.0, now=clock[0]) == pytest.approx(
+            (1 / 101) / 0.01, rel=1e-6)
+        snap = slo.snapshot(now=clock[0])
+        assert snap["windows"]["fast"]["violations"] == 1
+        assert snap["violations_total"] == 1
+
+    def test_window_ages_out(self):
+        clock = [0.0]
+        slo = SloTracker(objective_ms=20.0, objective_frac=0.99,
+                         fast_window_s=1.0, slow_window_s=4.0,
+                         bucket_s=0.05, clock=lambda: clock[0])
+        for _ in range(50):
+            slo.record(100.0, now=clock[0])       # all violations
+        assert slo.burn_rate(1.0, now=clock[0]) == pytest.approx(100.0)
+        clock[0] += 2.0                           # past the fast window
+        assert slo.burn_rate(1.0, now=clock[0]) == 0.0
+        # the slow window still sees them
+        assert slo.burn_rate(4.0, now=clock[0]) == pytest.approx(100.0)
+
+
+class TestSyncTracing:
+    def _snapshot_with_traffic(self, clock, tracer):
+        ctxs = [tracer.begin(f"m{i}") for i in range(4)]
+        tb = tracer.batch(ctxs, batch_size=4)
+        for stage in ("assemble", "pack", "dispatch", "device_wait",
+                      "finalize"):
+            tb.mark(stage)
+            clock[0] += 0.003
+        tracer.finish_batch(tb)
+        return tracer.snapshot()
+
+    def test_counter_delta_mirror(self):
+        clock = [0.0]
+        tracer = _vclock_tracer(clock)
+        snap = self._snapshot_with_traffic(clock, tracer)
+        mc = MetricsCollector()
+        mc.sync_tracing(snap)
+        assert mc.trace_completed.value(terminal="scored") == 4
+        assert mc.trace_stage_ms.count(stage="assemble") == 4
+        assert mc.trace_stage_ms.sum(stage="assemble") == pytest.approx(
+            4 * 3.0, rel=0.01)
+        # honest deltas: an unchanged snapshot mirrors as +0
+        mc.sync_tracing(snap)
+        assert mc.trace_completed.value(terminal="scored") == 4
+        assert mc.trace_stage_ms.count(stage="assemble") == 4
+        # more traffic mirrors only the increment
+        snap2 = self._snapshot_with_traffic(clock, tracer)
+        mc.sync_tracing(snap2)
+        assert mc.trace_completed.value(terminal="scored") == 8
+        assert mc.trace_stage_ms.count(stage="assemble") == 8
+
+    def test_identical_series_from_two_collectors(self):
+        """Satellite: stream-job and serving mirror the SAME snapshot into
+        independent collectors — the rendered trace_* series must match."""
+        clock = [0.0]
+        tracer = _vclock_tracer(clock)
+        snap = self._snapshot_with_traffic(clock, tracer)
+        a, b = MetricsCollector(), MetricsCollector()
+        a.sync_tracing(snap)
+        b.sync_tracing(snap)
+
+        def trace_lines(mc):
+            return [ln for ln in mc.render_prometheus().splitlines()
+                    if ln.startswith("trace_")]
+
+        assert trace_lines(a) == trace_lines(b)
+
+    def test_exemplar_rendered_with_trace_id(self):
+        clock = [0.0]
+        tracer = _vclock_tracer(clock)
+        snap = self._snapshot_with_traffic(clock, tracer)
+        mc = MetricsCollector()
+        mc.sync_tracing(snap)
+        text = mc.render_prometheus()
+        ex_lines = [ln for ln in text.splitlines()
+                    if ln.startswith("# exemplar trace_stage_ms_bucket")]
+        assert ex_lines, "exemplar trace_ids must render as comment lines"
+        assert 'trace_id="' in ex_lines[0]
+        assert "trace_slo_burn_rate" in text
+        # classic text format (version=0.0.4): no sample line may carry
+        # trailing content — a trailing '#' would fail the WHOLE scrape
+        for ln in text.splitlines():
+            if ln and not ln.startswith("#"):
+                assert "#" not in ln, f"exemplar leaked onto sample: {ln}"
+
+    def test_slo_violation_counter_mirrors(self):
+        clock = [0.0]
+        tracer = _vclock_tracer(clock, slo_objective_ms=1.0)
+        self._snapshot_with_traffic(clock, tracer)   # e2e 15ms > 1ms
+        mc = MetricsCollector()
+        mc.sync_tracing(tracer.snapshot())
+        assert mc.trace_slo_violations.total() == 4
+        mc.sync_tracing(tracer.snapshot())
+        assert mc.trace_slo_violations.total() == 4
+
+
+class TestStreamJobTracing:
+    """Trace-context propagation through the REAL stream path."""
+
+    def _run_job(self, qos=None, n=96, batch=32):
+        from realtime_fraud_detection_tpu.obs.trace_drill import (
+            TraceDrillConfig,
+            TraceDrillScorer,
+        )
+        from realtime_fraud_detection_tpu.stream import (
+            InMemoryBroker,
+            JobConfig,
+            StreamJob,
+        )
+        from realtime_fraud_detection_tpu.stream import topics as T
+
+        clock = [0.0]
+        tracer = _vclock_tracer(clock, ring_size=1024)
+        scorer = TraceDrillScorer(clock, TraceDrillConfig(max_batch=batch))
+        broker = InMemoryBroker()
+        job = StreamJob(broker, scorer, JobConfig(
+            max_batch=batch, emit_features=False, emit_enriched=False,
+            qos=qos, tracing=tracer))
+        txns = [{"transaction_id": f"j{i}", "user_id": f"u{i % 7}",
+                 "merchant_id": "m1", "amount": 5.0 if i % 2 else 900.0,
+                 "timestamp": "0.0"}
+                for i in range(n)]
+        broker.produce_batch(T.TRANSACTIONS, txns,
+                             key_fn=lambda r: r["user_id"])
+        job.run_until_drained(now=0.0)
+        return tracer, job, txns
+
+    def test_every_scored_txn_has_one_trace(self):
+        tracer, job, txns = self._run_job()
+        scored = tracer.traces(terminal="scored")
+        assert len(scored) == len(txns)
+        assert {t.txn_id for t in scored} == \
+            {t["transaction_id"] for t in txns}
+        for t in scored:
+            assert {"queue", "assemble", "pack", "dispatch",
+                    "device_wait", "finalize"} <= set(t.stages)
+            assert t.meta["batch_size"] >= 1
+            assert t.meta["close_reason"] in (
+                "size", "deadline", "budget", "timeout", "flush")
+
+    def test_shed_txns_carry_terminal_shed_stage(self):
+        from realtime_fraud_detection_tpu.qos import QosPlane
+        from realtime_fraud_detection_tpu.utils.config import QosSettings
+
+        qos = QosPlane(QosSettings(enabled=True, admission_rate=1.0,
+                                   admission_burst=8.0))
+        tracer, job, txns = self._run_job(qos=qos)
+        assert job.counters["shed"] > 0
+        shed = tracer.traces(terminal="shed")
+        assert len(shed) == job.counters["shed"]
+        for t in shed:
+            assert t.terminal == "shed"
+            assert t.meta["reason"]
+        # shed + scored partition the admitted stream
+        assert len(shed) + len(tracer.traces(terminal="scored")) \
+            == len(txns)
+
+
+def test_trace_drill_fast_smoke(capsys):
+    """The `rtfd trace-drill --fast` acceptance path runs un-slow-marked
+    on every tier-1 pass — through the CLI entry, pinning attribution,
+    SLO reaction + recovery, FIFO/shed equality, and the overhead bound
+    (final stdout line: the compact <2 KB verdict)."""
+    from realtime_fraud_detection_tpu import cli
+
+    rc = cli.main(["trace-drill", "--fast"])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    compact = json.loads(out[-1])
+    assert len(out[-1].encode()) < 2048
+    assert compact["passed"] is True
+    assert compact["dominant"] == {"slow_assembly": "assemble",
+                                   "slow_device": "device_wait"}
+    assert compact["burn"]["slow_device_peak"] > compact["burn"]["threshold"]
+    full = json.loads(out[-2])
+    assert full["checks"]["noop_under_bound"]
+
+
+def test_tracing_overhead_guard_real_scorer():
+    """Tier-1 CI overhead guard: a fixed fake-Kafka workload on the REAL
+    scorer, tracing off vs on — the per-txn wall-clock ratio must stay
+    under the pinned bound (the plane is admissible on the hot path, not
+    just in the virtual drill). Batch 16 reuses the bucket other tier-1
+    suites already compiled in-process, so the guard costs seconds."""
+    import time
+
+    from realtime_fraud_detection_tpu.obs.tracing import Tracer as _Tracer
+    from realtime_fraud_detection_tpu.scoring import (
+        FraudScorer,
+        ScorerConfig,
+    )
+    from realtime_fraud_detection_tpu.sim.simulator import (
+        TransactionGenerator,
+    )
+    from realtime_fraud_detection_tpu.stream import (
+        InMemoryBroker,
+        JobConfig,
+        StreamJob,
+    )
+    from realtime_fraud_detection_tpu.stream import topics as T
+
+    batch, n = 16, 256
+
+    def soak(traced: bool) -> float:
+        gen = TransactionGenerator(num_users=500, num_merchants=100,
+                                   seed=13)
+        broker = InMemoryBroker()
+        s = FraudScorer(scorer_config=ScorerConfig())
+        s.seed_profiles(gen.users.profiles(), gen.merchants.profiles())
+        tracer = (_Tracer(TracingSettings(enabled=True))
+                  if traced else None)
+        job = StreamJob(broker, s, JobConfig(
+            max_batch=batch, emit_features=False, tracing=tracer))
+        broker.produce_batch(T.TRANSACTIONS, gen.generate_batch(n),
+                             key_fn=lambda r: str(r["user_id"]))
+        s.score_batch(gen.generate_batch(batch))     # compile outside
+        t0 = time.perf_counter()
+        job.run_until_drained(now=1000.0)
+        return time.perf_counter() - t0
+
+    # interleaved best-of-2 per arm damps scheduler noise; the bound is
+    # deliberately generous (tracing measures ~1.01x) so only a real
+    # hot-path regression trips it
+    off = min(soak(False), soak(False))
+    on = min(soak(True), soak(True))
+    assert on / off < 1.5, f"tracing overhead ratio {on / off:.3f} >= 1.5"

@@ -158,6 +158,11 @@ def app_server():
     # no fixed-port metrics listener in the shared fixture (8081 could
     # collide across test runs); the dedicated-port behavior has its own test
     config.monitoring.prometheus_port = 0
+    # tracing plane on: every /predict in this module flows through the
+    # flight recorder, so /latency/breakdown, /slo and the trace_* series
+    # are exercised against live traffic (the plane must not perturb any
+    # other endpoint's behavior — these tests pin that too)
+    config.tracing.enabled = True
     app = ServingApp(config, host="127.0.0.1", port=0)
     gen = TransactionGenerator(num_users=128, num_merchants=32)
     app.scorer.seed_profiles(gen.users.profiles(), gen.merchants.profiles())
@@ -615,6 +620,80 @@ class TestEndpoints:
         assert resp.status == 400
         resp.read()
         conn.close()
+
+
+class TestTracingEndpoints:
+    """The tracing plane's serving surface: /latency/breakdown, /slo, and
+    the trace_* Prometheus series, against live /predict traffic."""
+
+    def test_latency_breakdown_attributes_live_traffic(self, app_server):
+        app, gen = app_server
+        for _ in range(3):
+            status, _ = _request(app.port, "POST", "/predict", _txn(gen))
+            assert status == 200
+        status, bd = _request(app.port, "GET", "/latency/breakdown")
+        assert status == 200
+        assert bd["enabled"] is True
+        assert bd["n"] >= 3
+        p99 = bd["quantiles"]["p99"]
+        assert p99["dominant_stage"] in (
+            "queue", "assemble", "pack", "dispatch", "device_wait",
+            "finalize")
+        # additive decomposition: the stage means explain the tail e2e
+        assert sum(p99["stage_ms"].values()) > 0
+        assert {"queue", "assemble", "device_wait"} <= set(p99["stage_ms"])
+        assert bd["exemplars"] and bd["exemplars"][0]["trace_id"]
+
+    def test_slo_endpoint_reports_burn(self, app_server):
+        app, gen = app_server
+        _request(app.port, "POST", "/predict", _txn(gen))
+        status, slo = _request(app.port, "GET", "/slo")
+        assert status == 200
+        assert slo["enabled"] is True
+        assert slo["objective"]["latency_ms"] == 20.0
+        for window in ("fast", "slow"):
+            w = slo["windows"][window]
+            assert w["observed"] >= 1
+            assert w["burn_rate"] >= 0.0
+        assert "engaged" in slo["qos_gate"]
+
+    def test_trace_series_on_prometheus_exposition(self, app_server):
+        app, gen = app_server
+        _request(app.port, "POST", "/predict", _txn(gen))
+        status, text = _request(app.port, "GET", "/metrics/prometheus")
+        assert status == 200
+        assert "trace_stage_ms_bucket" in text
+        assert 'trace_completed_total{terminal="scored"}' in text
+        assert "trace_slo_burn_rate" in text
+
+    def test_cached_retry_closes_trace_as_cached(self, app_server):
+        app, gen = app_server
+        txn = _txn(gen)
+        _request(app.port, "POST", "/predict", txn)
+        before = app.tracer.counters["cached"]
+        _request(app.port, "POST", "/predict", txn)   # cache hit
+        assert app.tracer.counters["cached"] == before + 1
+
+    def test_error_path_closes_traces_as_error(self, app_server,
+                                               monkeypatch):
+        """A failing dispatch must still close every open trace with the
+        `error` terminal (the stream job records errors; the serving
+        plane must agree) — never a silent gap in the recorder."""
+        app, gen = app_server
+        txn = dict(_txn(gen), transaction_id="trace-err-1")
+        trace = app.tracer.batch(
+            [app.tracer.begin("trace-err-1")], batch_size=1)
+
+        def boom(*a, **k):
+            raise RuntimeError("injected dispatch failure")
+
+        monkeypatch.setattr(app.scorer, "dispatch", boom)
+        before = app.tracer.counters["errors"]
+        with pytest.raises(RuntimeError):
+            app._score_batch_sync([txn], trace)
+        assert app.tracer.counters["errors"] == before + 1
+        errs = app.tracer.traces(terminal="error")
+        assert any(t.txn_id == "trace-err-1" for t in errs)
 
 
 def test_serving_app_on_shared_state_tier():
