@@ -180,6 +180,14 @@ def _compact_summary(result: dict) -> dict:
             "p99_dominant_stage": to.get("p99_dominant_stage"),
         } if (to := result.get("trace_overhead") or {})
             and not to.get("error") else None),
+        "autotune": ({
+            "passed": at.get("passed"),
+            "controller_p99_ms": at.get("controller_p99_ms"),
+            "best_static_p99_ms": at.get("best_static_p99_ms"),
+            "p99_improvement_vs_best_static": at.get(
+                "p99_improvement_vs_best_static"),
+        } if (at := result.get("autotune") or {})
+            and not at.get("error") else None),
         "quality": ({"auc": quality.get("auc"),
                      "accuracy": quality.get("accuracy")}
                     if quality else None),
@@ -208,7 +216,7 @@ def _compact_summary(result: dict) -> dict:
     line = json.dumps(compact, separators=(",", ":"))
     while len(line.encode()) >= 2048:
         for victim in ("configs_txn_per_s", "operating_point", "quality",
-                       "host_assembly", "pool_scaling",
+                       "host_assembly", "pool_scaling", "autotune",
                        "latest_committed_tpu_capture",
                        "text_encoder", "error"):
             if compact.pop(victim, None) is not None:
@@ -909,6 +917,22 @@ def run_bench() -> None:
         _log(f'trace-overhead stage done: '
              f'{ {k: v for k, v in (result.get("trace_overhead") or {}).items() if not isinstance(v, dict)} }')
 
+    # ----------------------------------------------------- autotune stage
+    # Self-tuning host pipeline (tuning/): the deterministic drill's
+    # canned diurnal+burst load replayed through the pinned static grid
+    # and the JIT controller — static-best vs controller admitted p99 and
+    # throughput. Pure virtual-clock host arithmetic (no device work), so
+    # it is cheap and safe anywhere, but it reads as a host-plane result:
+    # the on-chip p99 wins live in the sweep stages above.
+    if remaining() > 45:
+        try:
+            _autotune_stage(result, snapshot)
+        except Exception as e:  # noqa: BLE001
+            result["autotune"] = {
+                "error": f"{type(e).__name__}: {e}"[:200]}
+        _log(f'autotune stage done: '
+             f'{ {k: v for k, v in (result.get("autotune") or {}).items() if not isinstance(v, dict)} }')
+
     # 3b. honest sequence lengths (VERDICT r3 missing-6): the reference
     # tokenizes at max_length 512 (bert_text_analyzer.py:201-202); seq 64
     # is the production truncation for short merchant/description strings.
@@ -1416,6 +1440,38 @@ def _trace_overhead_stage(result: dict, snapshot) -> None:
         "p99_stage_ms": p99.get("stage_ms"),
     }
     snapshot("trace_overhead")
+
+
+def _autotune_stage(result: dict, snapshot) -> None:
+    """Self-tuning host pipeline (ISSUE 6 bench satellite): the drill's
+    canned nonstationary load (fast config — deterministic, ~2 s of wall
+    time) through every pinned static deadline AND the JIT controller.
+    The drill and the tier-1 smoke pin the pass/fail bar; the bench
+    records the measured static-best-vs-controller comparison."""
+    from realtime_fraud_detection_tpu.tuning.drill import (
+        AutotuneDrillConfig,
+        run_autotune_drill,
+    )
+
+    s = run_autotune_drill(AutotuneDrillConfig.fast())
+    ctrl = s["controller"]
+    static_p99 = {k: v["p99_ms"] for k, v in s["static_grid"].items()}
+    best_static = min(static_p99, key=static_p99.get)
+    result["autotune"] = {
+        "passed": s["passed"],
+        "controller_p99_ms": ctrl["p99_ms"],
+        "controller_p50_ms": ctrl["p50_ms"],
+        "controller_tps": ctrl["throughput_tps"],
+        "best_static": best_static,
+        "best_static_p99_ms": static_p99[best_static],
+        "static_p99_ms": static_p99,
+        "p99_improvement_vs_best_static": round(
+            1.0 - ctrl["p99_ms"] / max(static_p99[best_static], 1e-9), 4),
+        "mean_batch": ctrl["mean_batch"],
+        "close_reasons": ctrl["close_reasons"],
+        "offered_n": s["offered"].get("n"),
+    }
+    snapshot("autotune")
 
 
 def _e2e_soak(result: dict, models, sc, bert_config, use_pallas: bool,

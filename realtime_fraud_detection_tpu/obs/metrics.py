@@ -480,6 +480,44 @@ class MetricsCollector:
             "SLO error-budget burn rate (1.0 = budget consumed exactly at "
             "the sustainable rate)", ("window",))
         self._trace_seen: Dict[Tuple[str, ...], Any] = {}
+        # microbatcher close reasons (stream MicrobatchAssembler +
+        # serving RequestMicrobatcher): why each batch handed off —
+        # size/deadline/budget/timeout/flush, plus jit under autotune.
+        # Mirrored from the batcher's close_reasons histogram by
+        # sync_microbatch at exposition time (honest counter deltas, so
+        # stream-job and serving expose identical series)
+        self.microbatch_close_reason = r.counter(
+            "microbatch_close_reason_total",
+            "Microbatch close decisions by trigger "
+            "(size/deadline/budget/timeout/flush/jit)", ("reason",))
+        self._close_reason_seen: Dict[str, float] = {}
+        # self-tuning plane (tuning/): arrival forecast, JIT close
+        # decision mix, live knob values, tuner trial/freeze audit —
+        # mirrored from TuningPlane.snapshot() by sync_autotune
+        self.autotune_decisions = r.counter(
+            "autotune_close_decisions_total",
+            "JIT controller decisions (jit/deadline close, wait)",
+            ("decision",))
+        self.autotune_tuner_events = r.counter(
+            "autotune_tuner_events_total",
+            "Online-tuner epoch outcomes "
+            "(trials/accepted/reverted/frozen_epochs)", ("event",))
+        self.autotune_forecast_tps = r.gauge(
+            "autotune_forecast_tps",
+            "Short-horizon forecast arrival rate (txn/s)")
+        self.autotune_max_wait_ms = r.gauge(
+            "autotune_max_wait_ms",
+            "Current tuned batch max-wait bound (ms)")
+        self.autotune_bucket_set = r.gauge(
+            "autotune_bucket_set",
+            "Index of the bucket set the tuner currently serves")
+        self.autotune_inflight_depth = r.gauge(
+            "autotune_inflight_depth",
+            "Overlap/in-flight depth the tuner currently recommends")
+        self.autotune_frozen = r.gauge(
+            "autotune_frozen",
+            "1 while the tuner is frozen by the QoS ladder / SLO burn")
+        self._autotune_seen: Dict[Tuple[str, str], float] = {}
 
     def sync_host_stats(self, host_stats: Mapping[str, Any]) -> None:
         """Mirror ``FraudScorer.host_stats()`` into the Prometheus series.
@@ -626,6 +664,48 @@ class MetricsCollector:
             burn = w.get("burn_rate")
             if burn is not None and math.isfinite(float(burn)):
                 self.trace_slo_burn.set(float(burn), window=window)
+
+    def sync_microbatch(self, close_reasons: Mapping[str, int]) -> None:
+        """Mirror a batcher's cumulative close-reason histogram
+        (``MicrobatchAssembler.close_reasons`` /
+        ``RequestMicrobatcher.close_reasons``) into
+        ``microbatch_close_reason_total``. Called at exposition time —
+        the batch-close hot path only ever bumps a plain dict — and
+        mirrored as counter DELTAS against last-seen values (the
+        honest-counter scheme every sync_* mirror here uses), so the
+        stream job and the serving app expose identical series."""
+        for reason, total in (close_reasons or {}).items():
+            delta = float(total) - self._close_reason_seen.get(reason, 0.0)
+            if delta > 0:
+                self.microbatch_close_reason.inc(delta, reason=str(reason))
+            self._close_reason_seen[reason] = float(total)
+
+    def sync_autotune(self, snapshot: Mapping[str, Any]) -> None:
+        """Mirror a ``TuningPlane.snapshot()`` into the autotune_*
+        series. Called at exposition time; cumulative counters mirror as
+        deltas against last-seen values — never a negative increment."""
+        ctrl = snapshot.get("controller") or {}
+        for decision, total in (ctrl.get("decisions") or {}).items():
+            key = ("decision", str(decision))
+            delta = float(total) - self._autotune_seen.get(key, 0.0)
+            if delta > 0:
+                self.autotune_decisions.inc(delta, decision=str(decision))
+            self._autotune_seen[key] = float(total)
+        tuner = snapshot.get("tuner") or {}
+        for event in ("trials", "accepted", "reverted", "frozen_epochs"):
+            total = (tuner.get("counters") or {}).get(event, 0)
+            key = ("tuner", event)
+            delta = float(total) - self._autotune_seen.get(key, 0.0)
+            if delta > 0:
+                self.autotune_tuner_events.inc(delta, event=event)
+            self._autotune_seen[key] = float(total)
+        self.autotune_forecast_tps.set(
+            float(snapshot.get("forecast_tps", 0.0)))
+        self.autotune_max_wait_ms.set(float(ctrl.get("max_wait_ms", 0.0)))
+        self.autotune_bucket_set.set(float(tuner.get("bucket_set_idx", 0)))
+        self.autotune_inflight_depth.set(
+            float(tuner.get("inflight_depth", 0)))
+        self.autotune_frozen.set(1.0 if tuner.get("frozen") else 0.0)
 
     # ------------------------------------------------------------- recording
     def record_prediction(self, decision: str, fraud_score: float,

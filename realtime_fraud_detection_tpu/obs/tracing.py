@@ -71,16 +71,21 @@ TRACE_STAGE_BUCKETS_MS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
 
 
 class TraceContext:
-    """Per-transaction trace state between admission and completion."""
+    """Per-transaction trace state between admission and completion.
+    ``priority`` is the QoS class the admission path assigned (empty when
+    no QoS plane classified the transaction) — it rides to the completed
+    trace so queue-wait attribution can split by class."""
 
-    __slots__ = ("trace_id", "txn_id", "t_admit", "ingest_lag_s")
+    __slots__ = ("trace_id", "txn_id", "t_admit", "ingest_lag_s",
+                 "priority")
 
     def __init__(self, trace_id: str, txn_id: str, t_admit: float,
-                 ingest_lag_s: float = 0.0):
+                 ingest_lag_s: float = 0.0, priority: str = ""):
         self.trace_id = trace_id
         self.txn_id = txn_id
         self.t_admit = t_admit
         self.ingest_lag_s = ingest_lag_s
+        self.priority = priority
 
 
 class TraceBatch:
@@ -113,10 +118,10 @@ class CompletedTrace:
     """An immutable completed trace row in the flight recorder."""
 
     __slots__ = ("trace_id", "txn_id", "t_start", "e2e_ms", "stages",
-                 "meta", "terminal")
+                 "meta", "terminal", "priority")
 
     def __init__(self, trace_id, txn_id, t_start, e2e_ms, stages, meta,
-                 terminal):
+                 terminal, priority=""):
         self.trace_id = trace_id
         self.txn_id = txn_id
         self.t_start = t_start          # tracer-clock start (admit - queue)
@@ -124,6 +129,7 @@ class CompletedTrace:
         self.stages = stages            # {stage: ms}, additive over e2e
         self.meta = meta
         self.terminal = terminal        # scored | shed | error | cached
+        self.priority = priority        # QoS class ("" = unclassified)
 
     def to_dict(self) -> Dict[str, Any]:
         return {
@@ -133,6 +139,7 @@ class CompletedTrace:
             "stages": {k: round(v, 4) for k, v in self.stages.items()},
             "meta": self.meta,
             "terminal": self.terminal,
+            "priority": self.priority,
         }
 
 
@@ -293,17 +300,19 @@ class Tracer:
 
     # ------------------------------------------------------------- lifecycle
     def begin(self, txn_id: str, ingest_lag_s: float = 0.0,
-              t_admit: Optional[float] = None) -> Optional[TraceContext]:
+              t_admit: Optional[float] = None,
+              priority: str = "") -> Optional[TraceContext]:
         """Open a trace at admission. Returns None when disabled — every
         downstream call site guards on the context, so the disabled plane
-        costs one branch."""
+        costs one branch. ``priority`` is the QoS class the admission path
+        assigned (queue-wait attribution splits on it)."""
         if not self.enabled:
             return None
         self.counters["started"] += 1
         return TraceContext(
             f"t{next(self._seq):08x}", str(txn_id),
             self._clock() if t_admit is None else t_admit,
-            max(0.0, float(ingest_lag_s)))
+            max(0.0, float(ingest_lag_s)), str(priority))
 
     def batch(self, contexts: Sequence[Optional[TraceContext]],
               **meta: Any) -> Optional[TraceBatch]:
@@ -343,7 +352,7 @@ class Tracer:
             completed.append(CompletedTrace(
                 ctx.trace_id, ctx.txn_id,
                 ctx.t_admit - ctx.ingest_lag_s, e2e_ms, stages,
-                trace.meta, terminal))
+                trace.meta, terminal, ctx.priority))
         with self._lock:
             for ct in completed:
                 self._record_locked(ct, now)
@@ -363,7 +372,7 @@ class Tracer:
             stages["ingest"] = ctx.ingest_lag_s * 1e3
         ct = CompletedTrace(ctx.trace_id, ctx.txn_id,
                             ctx.t_admit - ctx.ingest_lag_s, e2e_ms, stages,
-                            dict(meta), terminal)
+                            dict(meta), terminal, ctx.priority)
         with self._lock:
             self._record_locked(ct, now)
 
@@ -429,9 +438,22 @@ class Tracer:
             thresh = interpolated_percentile(e2e, q)
             tail = [t for t in traces if t.e2e_ms >= thresh] or traces[-1:]
             contrib: Dict[str, float] = {}
+            queue_by_prio: Dict[str, Dict[str, float]] = {}
             for t in tail:
                 for stage, ms in t.stages.items():
                     contrib[stage] = contrib.get(stage, 0.0) + ms
+                    if stage == "queue":
+                        # queue-wait attribution split by QoS class: each
+                        # class's share of the tail's SUMMED queue time,
+                        # so the per-class contributions (normalized by
+                        # the same tail_n) sum exactly to the aggregate
+                        # queue figure — "is high-value traffic the one
+                        # waiting?" has an additive answer
+                        row = queue_by_prio.setdefault(
+                            t.priority or "unclassified",
+                            {"ms": 0.0, "n": 0})
+                        row["ms"] += ms
+                        row["n"] += 1
             n = len(tail)
             contrib = {s: round(v / n, 4) for s, v in contrib.items()}
             dominant = max(contrib, key=contrib.get)
@@ -442,6 +464,12 @@ class Tracer:
                 "dominant_stage": dominant,
                 "dominant_frac": round(
                     contrib[dominant] / max(sum(contrib.values()), 1e-9), 4),
+                "queue_ms_by_priority": {
+                    p: {"contrib_ms": round(row["ms"] / n, 4),
+                        "tail_n": row["n"],
+                        "mean_ms": round(row["ms"] / max(row["n"], 1), 4)}
+                    for p, row in sorted(queue_by_prio.items())
+                },
             }
         return {
             "enabled": self.enabled,

@@ -26,6 +26,7 @@ bucketed dense batches instead of a sequential loop (main.py:235-248).
 from __future__ import annotations
 
 import asyncio
+import dataclasses
 import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
@@ -112,12 +113,52 @@ class ServingApp:
 
             self.tracer = Tracer(self.config.tracing)
         two_phase = sc.overlap_assembly or self.pool is not None
+        # self-tuning host pipeline (serving.autotune / config.tuning):
+        # the request microbatcher's close decisions move from the fixed
+        # deadline to the arrival-aware just-in-time controller; the
+        # online tuner reads the tracing plane's burn + the QoS ladder
+        # through signals_fn so it freezes during emergencies
+        self.tuning = None
+        if sc.autotune or self.config.tuning.enabled:
+            from realtime_fraud_detection_tpu.tuning import TuningPlane
+            from realtime_fraud_detection_tpu.utils.config import (
+                TuningSettings,
+            )
+
+            fields = {**dataclasses.asdict(self.config.tuning),
+                      "enabled": True}
+            if not two_phase or self.pool is not None:
+                # pin the tuner's in-flight dimension where this path
+                # cannot apply it: single-phase serving has no pipeline
+                # depth, and with a device pool the depth IS the pool's
+                # capacity — leaving the knob free would let the tuner
+                # "trial" a no-op change and accept measurement noise as
+                # an improvement
+                depth = (self.pool.total_slots()
+                         if self.pool is not None else 1)
+                fields["inflight_min"] = fields["inflight_max"] = depth
+            tset = TuningSettings(**fields)
+            tset.validate(qos=self.config.qos)
+            self.tuning = TuningPlane(tset)
+            self.tuning.signals_fn = lambda: (
+                (self.tracer.slo.burn_rate(
+                    self.config.tracing.slo_fast_window_s)
+                 if self.tracer is not None else 0.0),
+                (self.qos.effective_level() if self.qos.enabled else 0))
         self.batcher = RequestMicrobatcher(
             self._score_batch_sync,
             max_batch=sc.microbatch_max_size,
             deadline_ms=sc.microbatch_deadline_ms,
             budget=self.qos.budget if self.config.qos.enabled else None,
             tracer=self.tracer,
+            controller=self.tuning,
+            # priority stamping mirrors the stream job: classes appear in
+            # the queue-wait split only while the QoS plane is ENABLED
+            # (it can be toggled at runtime via POST /qos) — without it,
+            # traffic reports as "unclassified", never as classes that
+            # no admission decision actually used
+            classify_fn=lambda t: (self.qos.classify(t)
+                                   if self.qos.enabled else ""),
             # two-phase pipelined scoring (serving.overlap_assembly): the
             # drain task dispatches batch N+1 (cache check + assembly +
             # device launch) while batch N still waits on the device in its
@@ -388,6 +429,7 @@ class ServingApp:
         r("GET", "/quality/live", self._quality_live)
         r("GET", "/latency/breakdown", self._latency_breakdown)
         r("GET", "/slo", self._slo_status)
+        r("GET", "/autotune", self._autotune_status)
 
     def _admit(self, n: int) -> None:
         limit = self.config.serving.max_concurrent_predictions
@@ -505,10 +547,13 @@ class ServingApp:
         # feedback plane's prequential/label/promotion series into the
         # registry at scrape time (cheap gauge sets + counter deltas)
         self.metrics.sync_host_stats(self.scorer.host_stats())
+        self.metrics.sync_microbatch(self.batcher.close_reasons)
         if self.pool is not None:
             self.metrics.sync_device_pool(self.pool.stats())
         if self.tracer is not None:
             self.metrics.sync_tracing(self.tracer.snapshot())
+        if self.tuning is not None:
+            self.metrics.sync_autotune(self.tuning.snapshot())
         if self.config.feedback.enabled:
             with self._score_lock:
                 snap = self.feedback.snapshot()
@@ -735,6 +780,16 @@ class ServingApp:
             "threshold": self.config.tracing.slo_burn_threshold,
         }
         return 200, payload
+
+    async def _autotune_status(self, body, query) -> Tuple[int, Any]:
+        """Self-tuning plane state: the forecast, the JIT controller's
+        decision mix + live knob values, and the tuner's trial/freeze
+        counters (tuning/plane.py snapshot)."""
+        if self.tuning is None:
+            return 200, {"enabled": False,
+                         "hint": "start with --autotune or "
+                                 "config.tuning.enabled"}
+        return 200, self.tuning.snapshot()
 
     async def _drift(self, body, query) -> Tuple[int, Any]:
         rep = self.drift.report()

@@ -31,8 +31,18 @@ per-request FIFO regardless of which replica scored which batch.
 from __future__ import annotations
 
 import asyncio
+import math
 import time
-from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence
+from typing import (
+    Any,
+    Callable,
+    Dict,
+    List,
+    Mapping,
+    Optional,
+    Sequence,
+    Tuple,
+)
 
 __all__ = ["RequestMicrobatcher"]
 
@@ -51,6 +61,8 @@ class RequestMicrobatcher:
         finalize_fn: Optional[Callable[[Any], List[Dict[str, Any]]]] = None,
         pipeline_depth: int = 2,
         tracer=None,
+        controller=None,
+        classify_fn: Optional[Callable[[Mapping[str, Any]], str]] = None,
     ):
         self.score_fn = score_fn
         self.max_batch = max_batch
@@ -58,6 +70,24 @@ class RequestMicrobatcher:
         # optional qos.LatencyBudget: per-request enqueue timestamps bound
         # the close deadline by the oldest waiter's remaining budget
         self.budget = budget
+        # optional tuning.TuningPlane (serving.autotune): arrival-aware
+        # just-in-time closing replaces the fixed assembly deadline —
+        # every submit feeds its forecaster (time.monotonic, the same
+        # base as the drain loop's clock), and the drain loop asks it
+        # per wakeup whether waiting for one more request is expected to
+        # lower admitted p99. The QoS budget bound ALWAYS still caps the
+        # wait (close_by is passed through), so a controller can never
+        # outwait a latency budget. None = bit-identical to today.
+        self.controller = controller
+        # optional priority classifier (qos.QosPlane.classify): stamps
+        # each traced request's priority class so the tracing plane can
+        # split queue-wait attribution by class (/latency/breakdown)
+        self.classify_fn = classify_fn
+        # close-reason histogram (size/deadline/budget/jit/flush) for the
+        # Prometheus mirror (MetricsCollector.sync_microbatch) — the
+        # serving twin of MicrobatchAssembler.close_reasons
+        self.last_close_reason: Optional[str] = None
+        self.close_reasons: Dict[str, int] = {}
         # optional obs.tracing.Tracer: each drained batch gets a
         # TraceBatch whose per-request admission time is the enqueue
         # timestamp (same time.monotonic base as the tracer's clock), so
@@ -114,7 +144,10 @@ class RequestMicrobatcher:
         if self._closed:
             raise RuntimeError("microbatcher is stopped")
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        self._queue.put_nowait((txn, fut, time.monotonic()))
+        now = time.monotonic()
+        if self.controller is not None:
+            self.controller.observe(now)
+        self._queue.put_nowait((txn, fut, now))
         return fut
 
     async def submit(self, txn: Mapping[str, Any]) -> Dict[str, Any]:
@@ -122,18 +155,32 @@ class RequestMicrobatcher:
         if self._closed:
             raise RuntimeError("microbatcher is stopped")
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        await self._queue.put((txn, fut, time.monotonic()))
+        now = time.monotonic()
+        if self.controller is not None:
+            self.controller.observe(now)
+        await self._queue.put((txn, fut, now))
         return await fut
 
     # ---------------------------------------------------------------- drain
-    def _close_at(self, first_item) -> float:
-        """When must the batch containing ``first_item`` hand off? The
-        assembly window from now, capped by the oldest waiter's remaining
-        latency budget (it is the oldest: the queue is FIFO)."""
-        deadline = time.monotonic() + self.deadline_s
+    def _close_at(self, first_item) -> Tuple[float, str]:
+        """When must the batch containing ``first_item`` hand off, and why?
+        The assembly window from now, capped by the oldest waiter's
+        remaining latency budget (it is the oldest: the queue is FIFO).
+        With a controller attached the fixed window drops out — only the
+        budget bound remains (the controller owns the wait inside it)."""
+        if self.controller is not None:
+            deadline, kind = math.inf, "deadline"
+        else:
+            deadline, kind = time.monotonic() + self.deadline_s, "deadline"
         if self.budget is not None:
-            deadline = min(deadline, self.budget.close_by(first_item[2]))
-        return deadline
+            by = self.budget.close_by(first_item[2])
+            if by < deadline:
+                deadline, kind = by, "budget"
+        return deadline, kind
+
+    def _note_close(self, reason: str) -> None:
+        self.last_close_reason = reason
+        self.close_reasons[reason] = self.close_reasons.get(reason, 0) + 1
 
     async def _drain(self) -> None:
         loop = asyncio.get_running_loop()
@@ -143,21 +190,58 @@ class RequestMicrobatcher:
                 await self._flush_remaining(loop)
                 return
             batch = [first]
-            deadline = self._close_at(first)
+            if self.controller is not None:
+                # drain everything ALREADY queued before asking the
+                # controller: its headroom is measured from the first
+                # waiter's enqueue instant, so after a backpressure stall
+                # an aged first item would otherwise deadline-close at
+                # n=1 while a full batch sits in the queue — the JIT path
+                # must see the backlog the way the stream assembler does
+                # (poll first, decide second)
+                while len(batch) < self.max_batch:
+                    try:
+                        item = self._queue.get_nowait()
+                    except asyncio.QueueEmpty:
+                        break
+                    if item is None:             # stop sentinel
+                        self._note_close("flush")
+                        await self._score(loop, batch)
+                        await self._flush_remaining(loop)
+                        return
+                    batch.append(item)
+            deadline, bound_kind = self._close_at(first)
+            reason = "size"
             while len(batch) < self.max_batch:
-                remaining = deadline - time.monotonic()
+                now = time.monotonic()
+                remaining = deadline - now
                 if remaining <= 0:
+                    reason = bound_kind
                     break
+                timeout = remaining
+                if self.controller is not None:
+                    d = self.controller.should_close(
+                        len(batch), first[2], now,
+                        close_by=(deadline if math.isfinite(deadline)
+                                  else None))
+                    if d.close:
+                        reason = d.reason
+                        break
+                    timeout = min(timeout, d.recheck_s)
                 try:
                     item = await asyncio.wait_for(
-                        self._queue.get(), timeout=remaining)
+                        self._queue.get(), timeout=timeout)
                 except asyncio.TimeoutError:
+                    if self.controller is not None:
+                        continue                 # re-decide on the new now
+                    reason = bound_kind
                     break
                 if item is None:
+                    self._note_close("flush")
                     await self._score(loop, batch)
                     await self._flush_remaining(loop)
                     return
                 batch.append(item)
+            self._note_close(reason)
             await self._score(loop, batch)
 
     async def _flush_remaining(self, loop) -> None:
@@ -170,6 +254,7 @@ class RequestMicrobatcher:
             if item is not None:
                 leftovers.append(item)
         for i in range(0, len(leftovers), self.max_batch):
+            self._note_close("flush")
             await self._score(loop, leftovers[i:i + self.max_batch])
         await self._join_pipeline()
 
@@ -184,13 +269,31 @@ class RequestMicrobatcher:
 
     def _trace_for(self, batch):
         """Open a TraceBatch for a drained batch (None when untraced):
-        admission = the request's enqueue instant, so queue wait is real."""
+        admission = the request's enqueue instant, so queue wait is real.
+        With a classifier attached, each context carries its QoS priority
+        class so /latency/breakdown can split queue-wait by class."""
         if self.tracer is None or not self.tracer.enabled:
             return None
+        cls = self.classify_fn
         return self.tracer.batch(
             [self.tracer.begin(str(t.get("transaction_id", "")),
-                               t_admit=ts) for t, _, ts in batch],
-            batch_size=len(batch))
+                               t_admit=ts,
+                               priority=(cls(t) if cls is not None else ""))
+             for t, _, ts in batch],
+            batch_size=len(batch),
+            close_reason=self.last_close_reason)
+
+    def _feed_tuning(self, n: int, t_dispatch: float, enq_ts) -> None:
+        """Completed-batch observation into the tuning plane (no-op for a
+        bare controller or with tuning off): service time = dispatch→now,
+        per-request latency = enqueue→now — the queue wait the JIT
+        decision caused is part of the objective it is judged on."""
+        cb = getattr(self.controller, "on_batch_complete", None)
+        if cb is None:
+            return
+        now = time.monotonic()
+        cb(n, max(0.0, now - t_dispatch), now,
+           latencies_ms=[(now - t) * 1e3 for t in enq_ts])
 
     async def _score(self, loop, batch) -> None:
         if self.dispatch_fn is not None:
@@ -199,6 +302,7 @@ class RequestMicrobatcher:
         txns = [t for t, _, _ in batch]
         futs = [f for _, f, _ in batch]
         trace = self._trace_for(batch)
+        t_disp = time.monotonic()
         try:
             # device work off the event loop; one fused program per batch
             if trace is not None:
@@ -214,6 +318,7 @@ class RequestMicrobatcher:
             return
         self.batches += 1
         self.requests += len(batch)
+        self._feed_tuning(len(batch), t_disp, [ts for _, _, ts in batch])
         for f, r in zip(futs, results):
             if not f.done():                     # waiter may have timed out
                 f.set_result(r)
@@ -230,6 +335,7 @@ class RequestMicrobatcher:
         txns = [t for t, _, _ in batch]
         futs = [f for _, f, _ in batch]
         trace = self._trace_for(batch)
+        t_disp = time.monotonic()
         try:
             if trace is not None:
                 ctx = await loop.run_in_executor(
@@ -244,7 +350,15 @@ class RequestMicrobatcher:
             return
         prev = self._inflight[-1] if self._inflight else None
         self._inflight.append(loop.create_task(
-            self._finalize(loop, prev, ctx, futs, len(batch))))
+            self._finalize(loop, prev, ctx, futs, len(batch),
+                           t_disp, [ts for _, _, ts in batch])))
+        # with a tuning plane attached, the pipeline depth follows the
+        # online tuner (re-read per batch, so a tuner move takes effect
+        # one batch later); the serving app pins the tuner's range when
+        # this path cannot apply it (single-phase / device pool)
+        rec = getattr(self.controller, "recommended_inflight_depth", None)
+        if rec is not None:
+            self.pipeline_depth = max(1, int(rec()))
         # bound the pipeline: wait for the oldest finalize once depth
         # batches are in flight (device backpressure reaches the queue)
         while len(self._inflight) > self.pipeline_depth:
@@ -255,7 +369,8 @@ class RequestMicrobatcher:
                 pass
 
     async def _finalize(self, loop, prev: Optional[asyncio.Task], ctx,
-                        futs, n: int) -> None:
+                        futs, n: int, t_disp: float = 0.0,
+                        enq_ts=()) -> None:
         if prev is not None:
             try:
                 await prev                       # completion stays in order
@@ -270,6 +385,7 @@ class RequestMicrobatcher:
             return
         self.batches += 1
         self.requests += n
+        self._feed_tuning(n, t_disp, enq_ts)
         for f, r in zip(futs, results):
             if not f.done():
                 f.set_result(r)

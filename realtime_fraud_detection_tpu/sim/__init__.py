@@ -8,3 +8,7 @@ from realtime_fraud_detection_tpu.sim.fraud_patterns import (  # noqa: F401
     AdvancedFraudPatterns,
     BASIC_FRAUD_MIX,
 )
+from realtime_fraud_detection_tpu.sim.arrivals import (  # noqa: F401
+    DiurnalBurstConfig,
+    DiurnalBurstProcess,
+)

@@ -106,6 +106,15 @@ class JobConfig:
     # terminal `shed` trace. None or enabled=False = off, and the scoring
     # path pays one `is None` branch per batch (the measured no-op path).
     tracing: Optional[Any] = None        # utils.config.TracingSettings|Tracer
+    # self-tuning host pipeline (tuning/): a TuningSettings (or a live
+    # TuningPlane) — the assembler's close decisions move from the fixed
+    # deadline to the arrival-aware just-in-time controller, and the
+    # online tuner adjusts the max-wait bound / bucket set / in-flight
+    # depth from completed-batch observations. None or enabled=False =
+    # off, and batch-close decisions are BIT-IDENTICAL to the fixed-
+    # deadline path (the assembler takes the controller branch only when
+    # one is attached).
+    autotune: Optional[Any] = None       # utils.config.TuningSettings|plane
     labels_topic: str = T.LABELS
     # topic names (reference JobConfig.java topic parameters); defaults are
     # the §2.5 contract (stream/topics.py) — overridable per deployment,
@@ -142,6 +151,10 @@ class _BatchCtx:
     shed: List[tuple] = dataclasses.field(default_factory=list)
     # tracing plane: this batch's TraceBatch carrier (None = tracing off)
     trace: Optional[Any] = None
+    # dispatch instant on the record-timestamp clock base (wall in
+    # production, virtual in drills): the tuning plane's service-time
+    # observation is completion minus this
+    t_dispatch: float = 0.0
 
 
 class StreamJob:
@@ -177,11 +190,22 @@ class StreamJob:
             from realtime_fraud_detection_tpu.qos import QosPlane
 
             self.qos = qs if isinstance(qs, QosPlane) else QosPlane(qs)
+        # self-tuning plane: the assembler consults its just-in-time
+        # controller instead of the fixed deadline; the run loops re-read
+        # its recommended in-flight depth each iteration
+        self.tuning = None
+        ts = self.config.autotune
+        if ts is not None and getattr(ts, "enabled", False):
+            from realtime_fraud_detection_tpu.tuning import TuningPlane
+
+            self.tuning = ts if isinstance(ts, TuningPlane) \
+                else TuningPlane(ts)
         self.assembler = MicrobatchAssembler(
             self.consumer,
             max_batch=self.config.max_batch,
             max_delay_ms=self.config.max_delay_ms,
             budget=self.qos.budget if self.qos is not None else None,
+            controller=self.tuning,
         )
         self.analytics = (
             WindowedAnalytics(broker) if self.config.enable_analytics else None
@@ -239,8 +263,13 @@ class StreamJob:
     def _inflight_depth(self) -> int:
         """Run-loop in-flight window: the configured pipeline depth, raised
         to the device pool's capacity when one is attached — a window
-        smaller than devices x depth would leave replicas starved."""
+        smaller than devices x depth would leave replicas starved. With
+        the tuning plane attached, its online-tuned depth replaces the
+        configured one (re-read every loop iteration, so a tuner move
+        takes effect one batch later); the pool floor still applies."""
         depth = max(1, self.config.pipeline_depth)
+        if self.tuning is not None:
+            depth = max(1, self.tuning.recommended_inflight_depth())
         if self.pool is not None:
             depth = max(depth, self.pool.total_slots())
         return depth
@@ -315,11 +344,13 @@ class StreamJob:
                 batch_ids.add(txn_id)
                 cached_dups.append((r, cached))
                 continue
+            priority = ""
             if self.qos is not None:
                 # admission AFTER dedupe (a replayed duplicate must not
                 # burn tokens) and BEFORE dispatch: a shed is an explicit
                 # decision recorded at completion, never a silent drop
                 decision = self.qos.admit(txn, t_adm)
+                priority = decision.priority
                 if not decision.admitted:
                     self.counters["shed"] += 1
                     shed.append((dataclasses.replace(r, value=txn),
@@ -328,7 +359,8 @@ class StreamJob:
                         # a shed is a recorded terminal trace, not a gap
                         tracer.finish_terminal(
                             tracer.begin(txn_id,
-                                         ingest_lag_s=_ingest_lag(r)),
+                                         ingest_lag_s=_ingest_lag(r),
+                                         priority=decision.priority),
                             "shed", reason=decision.reason,
                             priority=decision.priority)
                     continue
@@ -336,7 +368,8 @@ class StreamJob:
             fresh.append(dataclasses.replace(r, value=txn))
             if tracer is not None:
                 trace_ctxs.append(
-                    tracer.begin(txn_id, ingest_lag_s=_ingest_lag(r)))
+                    tracer.begin(txn_id, ingest_lag_s=_ingest_lag(r),
+                                 priority=priority))
         positions = self.consumer.snapshot_positions()
         if self.qos is not None:
             # backlog signal, one ladder observation per dispatched
@@ -387,7 +420,7 @@ class StreamJob:
             pass
         self._inflight_ids |= batch_ids
         return _BatchCtx(fresh, batch_ids, pending, positions, now, invalid,
-                         cached_dups, shed, trace)
+                         cached_dups, shed, trace, t_adm)
 
     def complete_batch(self, ctx: "_BatchCtx",
                        now: Optional[float] = None) -> List[Dict[str, Any]]:
@@ -460,6 +493,7 @@ class StreamJob:
             self._emit_cached_dups(ctx)
             out = invalid_results + self._fan_out(
                 ctx, fresh, results, feats, scored_ok, now)
+            burn = None
             if ctx.trace is not None and self.tracer is not None:
                 # emit complete: close every trace in the batch (the
                 # per-txn e2e/SLO observation happens here), then consult
@@ -467,16 +501,33 @@ class StreamJob:
                 # without the backlog signal ever tripping
                 self.tracer.finish_batch(
                     ctx.trace, terminal="scored" if scored_ok else "error")
+                # burn rate and trace completion share the tracer's
+                # clock (virtual in the drills), so no ``now`` is
+                # passed — one time base end to end. Computed once: the
+                # QoS gate and the tuning plane both consume it.
+                ts = self.tracer.settings
+                burn = self.tracer.slo.burn_rate(ts.slo_fast_window_s)
                 if self.qos is not None:
-                    # burn rate and trace completion share the tracer's
-                    # clock (virtual in the drills), so no ``now`` is
-                    # passed — one time base end to end
-                    ts = self.tracer.settings
                     self.qos.observe_slo_burn(
-                        self.tracer.slo.burn_rate(ts.slo_fast_window_s),
+                        burn,
                         threshold=ts.slo_burn_threshold,
                         patience=ts.slo_gate_patience,
                         up_patience=ts.slo_gate_up_patience)
+            if self.tuning is not None:
+                # close the tuning loop: the batch's dispatch→complete
+                # duration feeds the controller's T(bucket) model, the
+                # per-txn completion latencies feed the tuner's
+                # admitted-p99 objective, and the SLO burn + ladder level
+                # gate it (the tuner freezes during an emergency — it
+                # never fights the QoS ladder)
+                lat = [max(0.0, t_done - r.timestamp) * 1e3
+                       for r in fresh if r.timestamp is not None]
+                self.tuning.on_batch_complete(
+                    len(fresh), max(0.0, t_done - ctx.t_dispatch), t_done,
+                    latencies_ms=lat,
+                    burn_rate=burn if burn is not None else 0.0,
+                    ladder_level=(self.qos.effective_level()
+                                  if self.qos is not None else 0))
             if self.feedback is not None and scored_ok:
                 # feed the label join with exactly what was emitted, plus
                 # the assembled feature rows (the retrain corpus), then

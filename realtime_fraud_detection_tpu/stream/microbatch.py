@@ -38,6 +38,7 @@ class MicrobatchAssembler:
         idle_sleep_s: float = 0.0005,
         budget=None,
         budget_clock: Callable[[], float] = time.time,
+        controller=None,
     ):
         self.consumer = consumer
         self.max_batch = max_batch
@@ -51,16 +52,28 @@ class MicrobatchAssembler:
         # production, the virtual clock in the overload drill).
         self.budget = budget
         self.budget_clock = budget_clock
+        # optional tuning.TuningPlane (or bare JitBatchController):
+        # arrival-aware just-in-time closing REPLACES the fixed deadline —
+        # arrivals feed its forecaster on every poll (this clock's base),
+        # and the close decision weighs waiting for one more txn against
+        # the bucket pad-waste curve and the live service-time model.
+        # None (the default) keeps close decisions bit-identical to the
+        # fixed-deadline path; the budget trigger above ALWAYS runs first,
+        # so a controller can never outwait a QoS latency budget.
+        self.controller = controller
         self._pending: List[Record] = []
         self._first_ts: Optional[float] = None
         self._oldest_event_ts: Optional[float] = None
         self.batches_emitted = 0
         self.records_emitted = 0
         # why the LAST batch closed (size | deadline | budget | timeout |
-        # flush) — tail-attribution metadata for the tracing plane: a
+        # flush | jit) — tail-attribution metadata for the tracing plane: a
         # deadline-closed size-1 batch and a full size-256 batch have very
-        # different per-txn cost profiles
+        # different per-txn cost profiles. ``close_reasons`` accumulates
+        # the full histogram for the Prometheus mirror
+        # (obs.metrics.MetricsCollector.sync_microbatch).
         self.last_close_reason: Optional[str] = None
+        self.close_reasons: dict = {}
 
     def _deadline_passed(self) -> bool:
         return (
@@ -98,13 +111,21 @@ class MicrobatchAssembler:
                     self._oldest_event_ts = (
                         ts if self._oldest_event_ts is None
                         else min(self._oldest_event_ts, ts))
+                if got and self.controller is not None:
+                    self.controller.observe(self.clock(), len(got))
                 self._pending.extend(got)
 
             if len(self._pending) >= self.max_batch:
                 return self._emit("size")
             if self._pending and self._budget_low():
                 return self._emit("budget")
-            if self._pending and self._deadline_passed():
+            if self.controller is not None:
+                if self._pending:
+                    d = self.controller.should_close(
+                        len(self._pending), self._first_ts, self.clock())
+                    if d.close:
+                        return self._emit(d.reason)
+            elif self._pending and self._deadline_passed():
                 return self._emit("deadline")
 
             if not block:
@@ -115,6 +136,7 @@ class MicrobatchAssembler:
 
     def _emit(self, reason: str = "size") -> List[Record]:
         self.last_close_reason = reason
+        self.close_reasons[reason] = self.close_reasons.get(reason, 0) + 1
         batch, self._pending = self._pending[: self.max_batch], self._pending[self.max_batch:]
         self._first_ts = self.clock() if self._pending else None
         if self.budget is not None and self._pending:

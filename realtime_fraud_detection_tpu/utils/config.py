@@ -125,6 +125,12 @@ class ServingConfig:
     # in-flight window.
     device_pool: bool = False
     inflight_depth: int = 2
+    # self-tuning host pipeline (tuning/): arrival-aware just-in-time
+    # batch closing + the online config tuner drive the microbatcher's
+    # close decisions instead of the fixed deadline. Knobs live in
+    # Config.tuning (TuningSettings); this switch attaches the plane to
+    # the serving path. Off = close decisions bit-identical to today.
+    autotune: bool = False
 
 
 @dataclass
@@ -283,6 +289,116 @@ class TracingSettings:
             raise ValueError(
                 "tracing SLO gate requires burn_threshold > 0 and "
                 "patience/up_patience >= 1")
+
+
+@dataclass
+class TuningSettings:
+    """Self-tuning host pipeline knobs (tuning/): arrival-rate forecast,
+    just-in-time batch closing, and the gradient-free online config tuner.
+
+    Disabled by default — the plane is opt-in per deployment (``serve
+    --autotune``, ``run-job --autotune``, or config/JSON overlay). With it
+    off, batch-close decisions are BIT-IDENTICAL to the fixed-deadline
+    path (the microbatchers take the controller branch only when one is
+    attached). All knobs are host state; nothing recompiles.
+    """
+
+    enabled: bool = False
+    # arrival forecaster (tuning/forecast.py): Holt double-exponential
+    # smoothing over time-bucketed admission counts. bucket_s is the
+    # counting granularity (and the forecast reaction time); alpha/beta
+    # the level/trend smoothing factors
+    forecast_bucket_s: float = 0.02
+    forecast_alpha: float = 0.5
+    forecast_beta: float = 0.2
+    # just-in-time closer (tuning/controller.py): the tuned max-wait
+    # deadline moves within [deadline_min_ms, deadline_max_ms]; with a
+    # QoS plane configured, deadline_max_ms must leave the budget's
+    # assembly slice intact (validated — the tuner can NEVER starve a
+    # latency budget the QoS plane promised)
+    deadline_min_ms: float = 0.25
+    deadline_max_ms: float = 10.0
+    # free-rider patience: waiting for one more (service-free, pad-riding)
+    # txn is worth `patience_factor x T(bucket) / fill` of the current
+    # waiters' time — the marginal-gain-vs-cost knob (arXiv:1904.07421)
+    patience_factor: float = 1.0
+    # candidate bucket sets the tuner may select among (index 0 is the
+    # starting set). Each must be a non-empty ascending list of positive
+    # sizes; the defaults are subsets of core/batching.BATCH_BUCKETS so a
+    # tuned close boundary always lands on a compile-cached padded shape
+    # (closing at an off-bucket size pads up and wastes the difference).
+    bucket_sets: List[List[int]] = field(default_factory=lambda: [
+        [1, 8, 32, 128, 256],
+        [1, 32, 256],
+        [1, 8, 32, 256],
+    ])
+    # online tuner (tuning/tuner.py): epoch length in completed batches,
+    # the relative admitted-p99 improvement required to KEEP a move (the
+    # hysteresis), and the post-move cooldown in epochs
+    tune_interval_batches: int = 50
+    hysteresis_frac: float = 0.05
+    tuner_cooldown_epochs: int = 2
+    # overlap / in-flight depth search range
+    inflight_min: int = 1
+    inflight_max: int = 4
+
+    def clamp_to_qos(self, qos: "QosSettings | None") -> None:
+        """Clamp the deadline search space to the QoS budget's assembly
+        slice, then re-validate — the ONE clamp-then-check recipe the CLI
+        entry points (`serve --autotune`, `run-job --autotune`) apply, so
+        the floor rule can never diverge between them."""
+        if qos is not None and getattr(qos, "enabled", False):
+            limit = qos.budget_ms - qos.assemble_margin_ms
+            self.deadline_max_ms = min(self.deadline_max_ms, limit)
+            self.deadline_min_ms = min(self.deadline_min_ms,
+                                       self.deadline_max_ms)
+        self.validate(qos=qos)
+
+    def validate(self, qos: "QosSettings | None" = None) -> None:
+        if not (0.0 < self.deadline_min_ms <= self.deadline_max_ms):
+            raise ValueError(
+                f"tuning deadline bounds must satisfy 0 < deadline_min_ms "
+                f"<= deadline_max_ms, got min={self.deadline_min_ms} "
+                f"max={self.deadline_max_ms}")
+        if not self.bucket_sets:
+            raise ValueError("tuning.bucket_sets must not be empty")
+        for bs in self.bucket_sets:
+            if not bs or list(bs) != sorted(bs) or min(bs) < 1 \
+                    or len(set(bs)) != len(bs):
+                raise ValueError(
+                    f"every tuning bucket set must be a non-empty strictly "
+                    f"ascending list of positive sizes, got {bs!r}")
+        if not (0.0 < self.forecast_alpha <= 1.0
+                and 0.0 <= self.forecast_beta <= 1.0
+                and self.forecast_bucket_s > 0):
+            raise ValueError(
+                "tuning forecast requires 0 < alpha <= 1, 0 <= beta <= 1 "
+                "and bucket_s > 0")
+        if self.tune_interval_batches < 1 or self.hysteresis_frac < 0 \
+                or self.tuner_cooldown_epochs < 0:
+            raise ValueError(
+                "tuning requires tune_interval_batches >= 1, "
+                "hysteresis_frac >= 0 and tuner_cooldown_epochs >= 0")
+        if not (1 <= self.inflight_min <= self.inflight_max):
+            raise ValueError(
+                f"tuning requires 1 <= inflight_min <= inflight_max, got "
+                f"min={self.inflight_min} max={self.inflight_max}")
+        if self.patience_factor <= 0:
+            raise ValueError("tuning.patience_factor must be > 0")
+        if self.enabled and qos is not None \
+                and getattr(qos, "enabled", False):
+            # the hard QoS floor: the tuner's deadline search space may
+            # never reach past the budget's assembly slice — a tuned
+            # max-wait that outlives close_by would hold batches past the
+            # deadline the QoS plane promised every admitted transaction.
+            # Checked only when the plane is ON: a disabled tuner imposes
+            # no constraint on an otherwise-valid QoS config.
+            limit = qos.budget_ms - qos.assemble_margin_ms
+            if self.deadline_max_ms > limit:
+                raise ValueError(
+                    f"tuning.deadline_max_ms={self.deadline_max_ms} "
+                    f"violates the QoS budget: must be <= budget_ms - "
+                    f"assemble_margin_ms = {limit}")
 
 
 @dataclass
@@ -464,6 +580,7 @@ class Config:
     qos: QosSettings = field(default_factory=QosSettings)
     feedback: FeedbackSettings = field(default_factory=FeedbackSettings)
     tracing: TracingSettings = field(default_factory=TracingSettings)
+    tuning: TuningSettings = field(default_factory=TuningSettings)
 
     def __post_init__(self) -> None:
         self._apply_env()
@@ -640,6 +757,7 @@ class Config:
         self.qos.validate()
         self.feedback.validate()
         self.tracing.validate()
+        self.tuning.validate(qos=self.qos)
 
 
 def _merge_dataclass(obj: Any, data: Dict[str, Any]) -> None:

@@ -107,3 +107,80 @@ class TestFraudPatterns:
         moved = abs(out["geolocation"]["lat"] - 10.0) + abs(out["geolocation"]["lon"] - 10.0)
         assert moved > 0.0
         assert "device_fingerprint" in out
+
+
+class TestDiurnalBurstArrivals:
+    """Nonstationary offered-load process (sim/arrivals.py): diurnal ramp
+    + Poisson bursts, seedable and virtual-clock compatible (ISSUE 6
+    satellite)."""
+
+    def _proc(self, seed=7, **kw):
+        from realtime_fraud_detection_tpu.sim import (
+            DiurnalBurstConfig,
+            DiurnalBurstProcess,
+        )
+
+        defaults = dict(trough_tps=200.0, peak_tps=2000.0, period_s=4.0,
+                        burst_every_s=2.0, burst_offset_s=1.0,
+                        burst_duration_s=0.2, burst_mult=4.0)
+        defaults.update(kw)
+        return DiurnalBurstProcess(DiurnalBurstConfig(**defaults),
+                                   seed=seed)
+
+    def test_deterministic_per_seed(self):
+        a = self._proc(seed=7).generate(4.0)
+        b = self._proc(seed=7).generate(4.0)
+        c = self._proc(seed=8).generate(4.0)
+        assert np.array_equal(a, b)
+        assert not np.array_equal(a, c[: len(a)])
+
+    def test_sorted_within_window_from_t0(self):
+        p = self._proc(t0=100.0)
+        t = p.generate(4.0)
+        assert np.all(np.diff(t) >= 0)
+        assert t.min() >= 100.0 and t.max() < 104.0
+
+    def test_diurnal_envelope(self):
+        # deterministic intensity: trough at phase 0, peak at phase 0.5
+        p = self._proc(burst_duration_s=0.0)
+        assert p.rate_at(0.0) == pytest.approx(200.0)
+        assert p.rate_at(2.0) == pytest.approx(2000.0)   # period/2
+        # and the realized counts follow the envelope
+        t = p.generate(4.0)
+        trough = np.sum((t >= 0.0) & (t < 0.4))
+        peak = np.sum((t >= 1.8) & (t < 2.2))
+        assert peak > 3 * max(trough, 1)
+
+    def test_burst_elevates_rate(self):
+        p = self._proc()
+        # burst window [1.0, 1.2): 4x the diurnal rate at that phase
+        in_burst = p.rate_at(1.1)
+        just_after = p.rate_at(1.25)
+        assert in_burst == pytest.approx(4.0 * just_after, rel=0.15)
+        t = p.generate(4.0)
+        burst_n = np.sum((t >= 1.0) & (t < 1.2))
+        calm_n = np.sum((t >= 0.75) & (t < 0.95))
+        assert burst_n > 2 * max(calm_n, 1)
+
+    def test_validation(self):
+        from realtime_fraud_detection_tpu.sim import DiurnalBurstConfig
+
+        for bad in (dict(trough_tps=0.0), dict(trough_tps=500.0,
+                                               peak_tps=100.0),
+                    dict(period_s=0.0), dict(burst_mult=0.5),
+                    dict(burst_every_s=0.0)):
+            with pytest.raises(ValueError):
+                DiurnalBurstConfig(**bad).validate()
+
+    def test_paired_with_generator(self):
+        from realtime_fraud_detection_tpu.sim import TransactionGenerator
+
+        p = self._proc()
+        pairs = p.paired_with(
+            TransactionGenerator(num_users=50, num_merchants=10, seed=3),
+            1.0)
+        assert pairs
+        assert all(isinstance(ts, float) and "transaction_id" in txn
+                   for ts, txn in pairs)
+        s = p.summary([ts for ts, _ in pairs])
+        assert s["n"] == len(pairs) and s["mean_tps"] > 0

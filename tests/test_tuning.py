@@ -1,0 +1,623 @@
+"""Self-tuning host pipeline (tuning/): forecaster, JIT closer, tuner,
+plane wiring, close-reason mirror, per-class queue attribution, and the
+autotune drill smoke (ISSUE 6)."""
+
+import asyncio
+import json
+
+import pytest
+
+from realtime_fraud_detection_tpu.obs.metrics import MetricsCollector
+from realtime_fraud_detection_tpu.tuning import (
+    ArrivalForecaster,
+    ConfigTuner,
+    JitBatchController,
+    TuningPlane,
+)
+from realtime_fraud_detection_tpu.utils.config import (
+    Config,
+    QosSettings,
+    TuningSettings,
+)
+
+
+# ---------------------------------------------------------------- settings
+class TestTuningSettings:
+    def test_defaults_validate(self):
+        TuningSettings().validate()
+        Config()  # tree-level validation includes tuning
+
+    def test_rejects_deadline_bounds_violating_qos_budget(self):
+        qos = QosSettings(enabled=True, budget_ms=20.0,
+                          assemble_margin_ms=2.0)
+        with pytest.raises(ValueError, match="violates the QoS budget"):
+            TuningSettings(enabled=True,
+                           deadline_max_ms=18.5).validate(qos=qos)
+        # exactly the budget's assembly slice is allowed
+        TuningSettings(enabled=True, deadline_max_ms=18.0).validate(qos=qos)
+        # a disabled QoS plane imposes no floor
+        TuningSettings(enabled=True, deadline_max_ms=500.0).validate(
+            qos=QosSettings(enabled=False))
+        # and a DISABLED tuning plane imposes no constraint on an
+        # otherwise-valid QoS config (a tight budget must not start
+        # failing Config construction just because TuningSettings exists)
+        TuningSettings(enabled=False, deadline_max_ms=18.5).validate(qos=qos)
+        cfg = Config()
+        cfg.qos.enabled = True
+        cfg.qos.budget_ms = 8.0
+        cfg.validate()                           # tuning disabled: fine
+
+    def test_rejects_empty_or_malformed_bucket_sets(self):
+        with pytest.raises(ValueError, match="bucket_sets"):
+            TuningSettings(bucket_sets=[]).validate()
+        for bad in ([[]], [[8, 1]], [[0, 8]], [[8, 8, 32]]):
+            with pytest.raises(ValueError):
+                TuningSettings(bucket_sets=bad).validate()
+
+    def test_rejects_inverted_deadline_bounds(self):
+        with pytest.raises(ValueError, match="deadline"):
+            TuningSettings(deadline_min_ms=5.0,
+                           deadline_max_ms=1.0).validate()
+
+    def test_config_tree_rejects_tuning_qos_conflict(self):
+        cfg = Config()
+        cfg.qos.enabled = True
+        cfg.qos.budget_ms = 8.0        # assembly slice = 6 < default 10
+        cfg.tuning.enabled = True
+        with pytest.raises(ValueError, match="violates the QoS budget"):
+            cfg.validate()
+
+
+# -------------------------------------------------------------- forecaster
+class TestArrivalForecaster:
+    def test_steady_rate_converges(self):
+        f = ArrivalForecaster(bucket_s=0.02)
+        t = 0.0
+        for _ in range(2000):
+            f.observe(t)
+            t += 0.001                       # 1000 tps
+        assert f.rate(t) == pytest.approx(1000.0, rel=0.1)
+        assert f.expected_gap_s(t) == pytest.approx(0.001, rel=0.15)
+
+    def test_gap_ewma_reacts_within_a_few_arrivals(self):
+        f = ArrivalForecaster(bucket_s=0.02)
+        t = 0.0
+        for _ in range(200):
+            f.observe(t)
+            t += 0.001
+        # burst: 10x the rate — the gap estimate must follow within ~10
+        # arrivals, far faster than a counting bucket
+        for _ in range(12):
+            f.observe(t)
+            t += 0.0001
+        assert f.expected_gap_s(t) < 0.0005
+
+    def test_silence_floors_the_gap(self):
+        f = ArrivalForecaster(bucket_s=0.02)
+        t = 0.0
+        for _ in range(500):
+            f.observe(t)
+            t += 0.0002                      # 5k tps
+        # arrivals stop: the observed silence overrides the stale rate
+        assert f.expected_gap_s(t + 0.05) >= 0.05
+        # and a long silence decays the folded rate itself
+        assert f.rate(t + 10.0) == pytest.approx(0.0, abs=1.0)
+
+    def test_deterministic_replay(self):
+        def run():
+            f = ArrivalForecaster(bucket_s=0.01, alpha=0.6)
+            t = 0.0
+            out = []
+            for i in range(300):
+                f.observe(t, n=1 + i % 3)
+                t += 0.0007
+                out.append(round(f.rate(t), 6))
+            return out
+
+        assert run() == run()
+
+
+# -------------------------------------------------------------- controller
+def _fed_controller(rate_tps: float, t_end: float = 1.0,
+                    **kw) -> JitBatchController:
+    c = JitBatchController(**kw)
+    t = t_end - 0.2
+    gap = 1.0 / rate_tps
+    while t < t_end:
+        c.observe(t)
+        t += gap
+    return c
+
+
+class TestJitBatchController:
+    def test_trough_closes_immediately(self):
+        # 100 tps: waiting 10 ms for one more txn can never pay
+        c = _fed_controller(100.0)
+        d = c.should_close(1, first_ts=1.0, now=1.0001)
+        assert d.close and d.reason == "jit"
+
+    def test_high_rate_waits_then_closes_sustainably(self):
+        c = _fed_controller(20_000.0, max_wait_ms=10.0)
+        # teach the service model a fixed-cost curve
+        for b, ms in ((1, 2.0), (32, 2.2), (128, 2.8)):
+            c.observe_batch(b, ms / 1e3)
+        d = c.should_close(4, first_ts=1.0, now=1.0002)
+        assert not d.close                       # undersized: keep filling
+        d = c.should_close(120, first_ts=1.0, now=1.006)
+        assert d.close                           # sustainable: hand off
+
+    def test_max_wait_bound_closes_deadline(self):
+        c = _fed_controller(20_000.0, max_wait_ms=2.0)
+        d = c.should_close(4, first_ts=1.0, now=1.0025)
+        assert d.close and d.reason == "deadline"
+
+    def test_budget_close_by_caps_headroom(self):
+        c = _fed_controller(20_000.0, max_wait_ms=50.0)
+        # the QoS budget says hand off by t=1.001 — the controller must
+        # close NOW even though its own bound has headroom left
+        d = c.should_close(4, first_ts=1.0, now=1.002, close_by=1.001)
+        assert d.close and d.reason == "deadline"
+
+    def test_decisions_counted(self):
+        c = _fed_controller(100.0)
+        c.should_close(1, 1.0, 1.0001)
+        assert c.decisions["jit"] == 1
+        snap = c.snapshot()
+        assert snap["decisions"]["jit"] == 1
+        assert snap["buckets"] == [1, 8, 32, 128, 256]
+
+
+# ------------------------------------------------------------------- tuner
+def _tuner(**kw) -> ConfigTuner:
+    s = TuningSettings(enabled=True, tune_interval_batches=5,
+                       hysteresis_frac=0.05, tuner_cooldown_epochs=0, **kw)
+    c = JitBatchController(max_wait_ms=s.deadline_max_ms)
+    return ConfigTuner(s, c)
+
+
+def _feed_epoch(t, now, latency_ms, n=64):
+    for _ in range(t.settings.tune_interval_batches):
+        t.observe_result(latency_ms, n=4)
+        now += 0.01
+        t.on_batch(now)
+    return now
+
+
+class TestConfigTuner:
+    def test_trial_reverts_on_regression(self):
+        t = _tuner()
+        now = _feed_epoch(t, 0.0, 5.0)          # baseline epoch
+        now = _feed_epoch(t, now, 5.0)          # rolling baseline + trial
+        assert t.counters["trials"] == 1
+        saved = t._trial["saved"]
+        dim = t._trial["dim"]
+        now = _feed_epoch(t, now, 9.0)          # trial epoch measured WORSE
+        assert t.counters["reverted"] == 1
+        assert t._get(dim) == saved             # knob restored
+
+    def test_trial_accepted_on_improvement(self):
+        t = _tuner()
+        now = _feed_epoch(t, 0.0, 5.0)
+        now = _feed_epoch(t, now, 5.0)          # proposes a trial
+        assert t.counters["trials"] == 1
+        _feed_epoch(t, now, 3.0)                # clearly better
+        assert t.counters["accepted"] == 1
+
+    def test_freezes_when_ladder_degrades(self):
+        """Satellite: when the QoS ladder sits above rung 0 the tuner
+        must freeze — revert any in-flight trial and start none — rather
+        than fight the control loop that owns the emergency."""
+        t = _tuner()
+        now = _feed_epoch(t, 0.0, 5.0)
+        now = _feed_epoch(t, now, 5.0)
+        assert t._trial is not None
+        saved, dim = t._trial["saved"], t._trial["dim"]
+        for _ in range(t.settings.tune_interval_batches):
+            t.observe_result(5.0, n=4)
+            now += 0.01
+            t.on_batch(now, ladder_level=1)
+        assert t.frozen
+        assert t._trial is None
+        assert t._get(dim) == saved
+        assert t.counters["frozen_epochs"] == 1
+        assert t.counters["reverted"] == 1
+        # no new trial starts while frozen
+        for _ in range(t.settings.tune_interval_batches):
+            t.observe_result(5.0, n=4)
+            now += 0.01
+            t.on_batch(now, ladder_level=1)
+        assert t._trial is None and t.frozen
+        # calm again: unfreezes and resumes trialing eventually
+        now = _feed_epoch(t, now, 5.0)
+        assert not t.frozen
+
+    def test_deadline_knob_clamped_to_validated_range(self):
+        t = _tuner(deadline_min_ms=1.0, deadline_max_ms=4.0)
+        assert 1.0 <= t.controller.max_wait_ms <= 4.0
+        for _ in range(20):                     # no proposal may escape
+            for dim in ("max_wait",):
+                v = t._propose(dim)
+                if v is not None:
+                    assert 1.0 <= v <= 4.0
+                    t._set(dim, v)
+        assert 1.0 <= t.controller.max_wait_ms <= 4.0
+
+
+# ------------------------------------------------------------------- plane
+class TestTuningPlane:
+    def test_delegates_and_snapshots(self):
+        p = TuningPlane(TuningSettings(enabled=True))
+        for i in range(50):
+            p.observe(1.0 + i * 0.01)
+        d = p.should_close(1, 1.499, 1.5)
+        assert d.close and d.reason == "jit"     # 100 tps: close at once
+        p.on_batch_complete(32, 0.002, 1.5, latencies_ms=[3.0] * 8)
+        snap = p.snapshot()
+        assert snap["enabled"]
+        assert snap["controller"]["decisions"]["jit"] >= 1
+        assert "tuner" in snap and "forecast_tps" in snap
+
+    def test_signals_fn_feeds_freeze(self):
+        s = TuningSettings(enabled=True, tune_interval_batches=1)
+        p = TuningPlane(s)
+        p.signals_fn = lambda: (0.0, 2)          # ladder degraded
+        p.on_batch_complete(8, 0.001, 1.0, latencies_ms=[2.0])
+        assert p.tuner.frozen
+
+    def test_job_inflight_depth_follows_recommendation(self):
+        from realtime_fraud_detection_tpu.stream.job import (
+            JobConfig,
+            StreamJob,
+        )
+        from realtime_fraud_detection_tpu.stream.transport import (
+            InMemoryBroker,
+        )
+        from realtime_fraud_detection_tpu.tuning.drill import (
+            AutotuneDrillConfig,
+            AutotuneDrillScorer,
+        )
+
+        plane = TuningPlane(TuningSettings(
+            enabled=True, inflight_min=1, inflight_max=6))
+        job = StreamJob(InMemoryBroker(),
+                        AutotuneDrillScorer(AutotuneDrillConfig()),
+                        JobConfig(pipeline_depth=2, autotune=plane))
+        assert job.assembler.controller is plane
+        plane.tuner.inflight_depth = 5
+        assert job._inflight_depth() == 5
+
+    def test_sync_autotune_honest_deltas(self):
+        p = TuningPlane(TuningSettings(enabled=True))
+        for i in range(20):
+            p.observe(1.0 + i * 0.001)
+        p.should_close(1, 1.019, 1.02)
+        mc = MetricsCollector()
+        snap = p.snapshot()
+        mc.sync_autotune(snap)
+        total = mc.autotune_decisions.total()
+        assert total >= 1
+        mc.sync_autotune(snap)                   # unchanged → +0
+        assert mc.autotune_decisions.total() == total
+        a, b = MetricsCollector(), MetricsCollector()
+        a.sync_autotune(snap)
+        b.sync_autotune(snap)
+
+        def lines(m):
+            return [ln for ln in m.render_prometheus().splitlines()
+                    if ln.startswith("autotune_")]
+
+        assert lines(a) == lines(b)
+
+
+# -------------------------------------------------- close-reason mirroring
+class TestCloseReasonMirror:
+    def _stream_reasons(self):
+        from realtime_fraud_detection_tpu.stream import topics as T
+        from realtime_fraud_detection_tpu.stream.microbatch import (
+            MicrobatchAssembler,
+        )
+        from realtime_fraud_detection_tpu.stream.transport import (
+            InMemoryBroker,
+        )
+
+        clock = [0.0]
+        broker = InMemoryBroker()
+        consumer = broker.consumer([T.TRANSACTIONS], "g")
+        asm = MicrobatchAssembler(consumer, max_batch=4, max_delay_ms=5.0,
+                                  clock=lambda: clock[0])
+        for i in range(4):                       # one full batch
+            broker.produce(T.TRANSACTIONS, {"transaction_id": str(i)})
+        assert asm.next_batch(block=False)
+        assert asm.last_close_reason == "size"
+        broker.produce(T.TRANSACTIONS, {"transaction_id": "tail"})
+        assert asm.next_batch(block=False) == []
+        clock[0] += 0.006                        # deadline passes
+        assert asm.next_batch(block=False)
+        assert asm.last_close_reason == "deadline"
+        broker.produce(T.TRANSACTIONS, {"transaction_id": "tail2"})
+        asm.next_batch(block=False)
+        assert asm.flush()
+        return asm.close_reasons
+
+    def test_stream_assembler_histogram(self):
+        reasons = self._stream_reasons()
+        assert reasons == {"size": 1, "deadline": 1, "flush": 1}
+
+    def test_serving_batcher_histogram(self):
+        from realtime_fraud_detection_tpu.serving.batcher import (
+            RequestMicrobatcher,
+        )
+
+        async def main():
+            b = RequestMicrobatcher(lambda txns: [dict(t) for t in txns],
+                                    max_batch=2, deadline_ms=10.0)
+            await b.start()
+            # two concurrent submits → one size-closed batch
+            r = await asyncio.gather(b.submit({"transaction_id": "a"}),
+                                     b.submit({"transaction_id": "b"}))
+            assert len(r) == 2
+            # a lone submit → deadline close
+            await b.submit({"transaction_id": "c"})
+            await b.stop()
+            return dict(b.close_reasons)
+
+        reasons = asyncio.run(main())
+        assert reasons.get("size") == 1
+        assert reasons.get("deadline") == 1
+
+    def test_controller_batcher_drains_backlog_in_full_batches(self):
+        """Regression: after a stall, aged waiters must NOT deadline-
+        close at size 1 while a full batch sits in the queue — the JIT
+        path drains available requests before consulting the
+        controller (poll first, decide second)."""
+        from realtime_fraud_detection_tpu.serving.batcher import (
+            RequestMicrobatcher,
+        )
+
+        async def main():
+            sizes = []
+
+            def score(txns):
+                sizes.append(len(txns))
+                return [dict(t) for t in txns]
+
+            ctrl = JitBatchController(max_wait_ms=0.5)
+            b = RequestMicrobatcher(score, max_batch=8, deadline_ms=5.0,
+                                    controller=ctrl)
+            # a backlog forms while the drain task isn't running (the
+            # stalled-pipeline shape), and every waiter ages past the
+            # controller's max-wait bound
+            futs = [b.submit_nowait({"transaction_id": str(i)})
+                    for i in range(16)]
+            await asyncio.sleep(0.01)
+            await b.start()
+            await asyncio.gather(*futs)
+            await b.stop()
+            return sizes
+
+        sizes = asyncio.run(main())
+        assert max(sizes) == 8, sizes        # full batches, not size-1
+        assert len(sizes) <= 3
+
+    def test_mirror_identical_between_stream_and_serving(self):
+        """Satellite: the SAME close-reason histogram mirrored through
+        the stream job's and the serving app's collectors renders
+        identical microbatch_close_reason_total series, and re-syncing
+        an unchanged histogram adds zero (honest counters)."""
+        reasons = self._stream_reasons()
+        a, b = MetricsCollector(), MetricsCollector()
+        a.sync_microbatch(reasons)
+        b.sync_microbatch(reasons)
+
+        def lines(mc):
+            return [ln for ln in mc.render_prometheus().splitlines()
+                    if ln.startswith("microbatch_close_reason_total")]
+
+        assert lines(a) == lines(b)
+        assert a.microbatch_close_reason.value(reason="size") == 1
+        a.sync_microbatch(reasons)               # unchanged → +0
+        assert a.microbatch_close_reason.value(reason="size") == 1
+        reasons["size"] += 2
+        a.sync_microbatch(reasons)
+        assert a.microbatch_close_reason.value(reason="size") == 3
+
+
+# -------------------------------------------- per-class queue attribution
+class TestQueueByPriority:
+    def _tracer(self, clock):
+        from realtime_fraud_detection_tpu.obs.tracing import Tracer
+        from realtime_fraud_detection_tpu.utils.config import (
+            TracingSettings,
+        )
+
+        return Tracer(TracingSettings(enabled=True, slo_bucket_s=0.01,
+                                      slo_fast_window_s=1.0,
+                                      slo_slow_window_s=2.0),
+                      clock=lambda: clock[0])
+
+    def test_per_class_contributions_sum_to_aggregate(self):
+        """Regression pin: for every quantile, the per-class queue
+        contributions sum exactly to the aggregate queue figure."""
+        clock = [0.0]
+        tracer = self._tracer(clock)
+        for i in range(30):
+            # same e2e, mixed classes: both classes land in every tail
+            hi = tracer.begin(f"h{i}", t_admit=clock[0], priority="high")
+            lo = tracer.begin(f"l{i}", t_admit=clock[0], priority="low")
+            clock[0] += 0.004 + 0.0001 * (i % 5)
+            tb = tracer.batch([hi, lo], batch_size=2)
+            tb.mark("assemble")
+            clock[0] += 0.002
+            tracer.finish_batch(tb)
+        bd = tracer.breakdown()
+        for q in ("p50", "p95", "p99"):
+            row = bd["quantiles"][q]
+            split = row["queue_ms_by_priority"]
+            assert set(split) == {"high", "low"}
+            total = sum(v["contrib_ms"] for v in split.values())
+            assert total == pytest.approx(row["stage_ms"]["queue"],
+                                          rel=1e-3)
+
+    def test_split_names_the_waiting_class(self):
+        """The operator question the split answers: IS high-value
+        traffic the one waiting? Here only low-priority batches wait
+        long, so the tail's queue attribution must be all-low."""
+        clock = [0.0]
+        tracer = self._tracer(clock)
+        for i in range(20):
+            lo = tracer.begin(f"l{i}", t_admit=clock[0], priority="low")
+            clock[0] += 0.009                    # low waits 9 ms
+            tb = tracer.batch([lo], batch_size=1)
+            tb.mark("assemble")
+            clock[0] += 0.002
+            tracer.finish_batch(tb)
+            hi = tracer.begin(f"h{i}", t_admit=clock[0], priority="high")
+            clock[0] += 0.001                    # high waits 1 ms
+            tb = tracer.batch([hi], batch_size=1)
+            tb.mark("assemble")
+            clock[0] += 0.002
+            tracer.finish_batch(tb)
+        p99 = tracer.breakdown()["quantiles"]["p99"]
+        split = p99["queue_ms_by_priority"]
+        assert set(split) == {"low"}
+        assert split["low"]["contrib_ms"] == pytest.approx(
+            p99["stage_ms"]["queue"], rel=1e-3)
+
+    def test_unclassified_bucket_when_no_qos(self):
+        clock = [0.0]
+        tracer = self._tracer(clock)
+        ctx = tracer.begin("u1", t_admit=0.0)
+        clock[0] = 0.004
+        tb = tracer.batch([ctx], batch_size=1)
+        tb.mark("assemble")
+        clock[0] = 0.005
+        tracer.finish_batch(tb)
+        bd = tracer.breakdown()
+        assert set(bd["quantiles"]["p99"]["queue_ms_by_priority"]) == \
+            {"unclassified"}
+
+
+# ----------------------------------------------------- off-path identity
+class TestOffPathBitIdentical:
+    def _replay(self, autotune):
+        from realtime_fraud_detection_tpu.stream import topics as T
+        from realtime_fraud_detection_tpu.stream.job import (
+            JobConfig,
+            StreamJob,
+        )
+        from realtime_fraud_detection_tpu.stream.microbatch import (
+            MicrobatchAssembler,
+        )
+        from realtime_fraud_detection_tpu.stream.transport import (
+            InMemoryBroker,
+        )
+        from realtime_fraud_detection_tpu.tuning.drill import (
+            AutotuneDrillConfig,
+            AutotuneDrillScorer,
+        )
+
+        clock = [0.0]
+        broker = InMemoryBroker()
+        scorer = AutotuneDrillScorer(AutotuneDrillConfig())
+        job = StreamJob(broker, scorer, JobConfig(
+            max_batch=8, max_delay_ms=2.0, emit_features=False,
+            emit_enriched=False, autotune=autotune))
+        job.assembler = MicrobatchAssembler(
+            job.consumer, max_batch=8, max_delay_ms=2.0,
+            clock=lambda: clock[0], controller=job.tuning)
+        seq = []
+        for i in range(40):
+            broker.produce(T.TRANSACTIONS,
+                           {"transaction_id": f"x{i}", "user_id": "u",
+                            "amount": 10.0, "timestamp": str(clock[0])},
+                           timestamp=clock[0])
+            clock[0] += (0.0003 if i % 7 else 0.004)
+            batch = job.assembler.next_batch(block=False)
+            if batch:
+                seq.append((job.assembler.last_close_reason, len(batch)))
+                ctx = job.dispatch_batch(batch, now=clock[0])
+                if ctx is not None:
+                    job.complete_batch(ctx, now=clock[0])
+        tail = job.assembler.flush()
+        if tail:
+            seq.append((job.assembler.last_close_reason, len(tail)))
+        return seq
+
+    def test_autotune_off_is_the_fixed_deadline_path(self):
+        """With autotune off (the default), close decisions must be
+        bit-identical to the pre-tuning fixed-deadline behavior — the
+        assembler takes the controller branch only when one is attached,
+        and this sequence pins the off-path decisions exactly."""
+        a = self._replay(autotune=None)
+        b = self._replay(autotune=None)
+        assert a == b
+        assert all(r in ("size", "deadline", "flush") for r, _ in a)
+        assert any(r == "size" for r, _ in a)
+        assert any(r == "deadline" for r, _ in a)
+        # the JIT path makes different (jit-reason) decisions — proving
+        # the off path really is off, not coincidentally equal
+        s = TuningSettings(enabled=True)
+        c = self._replay(autotune=TuningPlane(s))
+        assert any(r == "jit" for r, _ in c)
+
+    def test_jobconfig_default_attaches_no_controller(self):
+        from realtime_fraud_detection_tpu.stream.job import (
+            JobConfig,
+            StreamJob,
+        )
+        from realtime_fraud_detection_tpu.stream.transport import (
+            InMemoryBroker,
+        )
+        from realtime_fraud_detection_tpu.tuning.drill import (
+            AutotuneDrillConfig,
+            AutotuneDrillScorer,
+        )
+
+        job = StreamJob(InMemoryBroker(),
+                        AutotuneDrillScorer(AutotuneDrillConfig()),
+                        JobConfig())
+        assert job.tuning is None
+        assert job.assembler.controller is None
+
+
+# -------------------------------------------------------------- the drill
+def test_autotune_drill_fast_smoke(capsys):
+    """Satellite: the `rtfd autotune-drill --fast` acceptance path runs
+    un-slow-marked on every tier-1 pass — through the CLI entry, pinning
+    that the JIT controller beats every static config on admitted p99 at
+    equal-or-better throughput with no high-value sheds, inside the QoS
+    budget, reproducibly (final stdout line: the compact <2 KB
+    verdict)."""
+    from realtime_fraud_detection_tpu import cli
+
+    rc = cli.main(["autotune-drill", "--fast"])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    compact = json.loads(out[-1])
+    assert len(out[-1].encode()) < 2048
+    assert compact["passed"] is True
+    assert compact["checks"]["beats_every_static_p99"]
+    assert compact["checks"]["throughput_equal_or_better"]
+    assert compact["checks"]["no_high_value_sheds"]
+    assert compact["checks"]["reproducible"]
+    best = min(compact["static_p99_ms"].values())
+    assert compact["controller"]["p99_ms"] < best
+    full = json.loads(out[-2])
+    assert full["checks"]["qos_budget_respected"]
+
+
+def test_arrival_process_feeds_the_drill():
+    """The drill consumes the first-class simulator arrival process —
+    same seed, same timeline."""
+    from realtime_fraud_detection_tpu.tuning.drill import (
+        AutotuneDrillConfig,
+        _arrivals,
+    )
+
+    cfg = AutotuneDrillConfig.fast()
+    a = _arrivals(cfg)
+    b = _arrivals(cfg)
+    assert [t for t, _ in a] == [t for t, _ in b]
+    assert all(a[i][0] <= a[i + 1][0] for i in range(len(a) - 1))
+    amounts = {txn["amount"] for _, txn in a}
+    assert amounts == {1000.0, 60.0, 5.0}
