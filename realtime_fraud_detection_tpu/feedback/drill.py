@@ -108,6 +108,7 @@ def _train_incumbent(cfg, gen, scorer) -> Dict[str, Any]:
     iforest = IsolationForestTrainer(n_estimators=48,
                                      seed=cfg.seed + 1).fit(
         x[y < 0.5][:4000])
+    # rtfd-lint: allow[lock-order] drill is single-threaded here (no batch in flight during the swap)
     scorer.set_models(scorer.models.replace(trees=trees, iforest=iforest))
     jax.block_until_ready(scorer.models.trees)
     return {"rows": int(len(y)), "fraud_rate": round(float(y.mean()), 4),

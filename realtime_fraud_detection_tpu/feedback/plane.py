@@ -276,6 +276,7 @@ class FeedbackPlane:
             raise RuntimeError(
                 "FeedbackPlane has no scorer/config and no promote_fn — "
                 "nothing to promote into")
+        # rtfd-lint: allow[lock-order] single-writer fallback path (job/drill); serving injects promote_fn bound to the score lock
         return promote_candidate(self.scorer, self.config, candidate)
 
     # ------------------------------------------------------------- snapshot

@@ -122,6 +122,7 @@ def log_model_event(logger: logging.Logger, model: str, event: str,
     """Model lifecycle events: loaded / reloaded / disabled / failed."""
     logger.info(
         "model_event",
+        # rtfd-lint: allow[wall-clock] ts_wall is the log line's wall stamp by contract
         extra={"event": event, "model": model, "ts_wall": time.time(),
                **fields},
     )

@@ -245,6 +245,7 @@ def run_overload_drill(
     recovery_observations = 0
     while plane.ladder.level > 0 and recovery_observations < 32:
         plane.observe_backlog(0)
+        # rtfd-lint: allow[lock-order] drill drives the plane from one thread on the virtual clock
         plane.apply_degradation(scorer)
         recovery_observations += 1
 

@@ -242,6 +242,7 @@ class QosPlane:
         """Count transactions scored at the current (degraded) rung."""
         level = self.effective_level()
         if n and level > 0:
+            # rtfd-lint: allow[metrics] n is this batch's event count — a delta by construction, not a cumulative mirror
             self.metrics.qos_degraded_scored.inc(
                 n, level=LADDER_LEVELS[level].name)
 

@@ -195,6 +195,11 @@ class ServingApp:
         # microbatcher thread and /batch-predict's executor thread both call
         # _score_batch_sync, so serialize them (the device is serial anyway)
         self._score_lock = threading.Lock()
+        # set by _predict (event loop) when the QoS served rung moved;
+        # consumed by _dispatch_batch_sync (executor) under _score_lock.
+        # Plain bool: single writer per side, torn reads impossible.
+        self._qos_rung_dirty = False
+        # rtfd-lint: allow[wall-clock] HTTP serving plane is real-time (no virtual-clock mode)
         self._started = time.monotonic()
         # admission control (reference config.py:86 max_concurrent_
         # predictions, enforced): transactions admitted but not yet
@@ -223,6 +228,7 @@ class ServingApp:
         two-phase microbatcher (serving.overlap_assembly) calls this for
         batch N+1 while batch N's ``_finalize_batch_sync`` is still waiting
         on the device — host assembly overlaps device compute."""
+        # rtfd-lint: allow[wall-clock] HTTP serving plane is real-time (no virtual-clock mode)
         t0 = time.perf_counter()
         # serve idempotent retries from the prediction cache; only misses
         # go to the device (reference TTL-cache semantics)
@@ -252,6 +258,12 @@ class ServingApp:
             pending = None
             if to_score:
                 with self._score_lock:
+                    if self._qos_rung_dirty and self.qos.enabled:
+                        # rung change flagged by _predict on the event
+                        # loop; applied here under the lock this thread
+                        # already holds for the dispatch
+                        self._qos_rung_dirty = False
+                        self.qos.apply_degradation(self.scorer)
                     pending = self.scorer.dispatch(to_score, trace=trace)
         except Exception:
             self.metrics.record_error("score")
@@ -284,6 +296,7 @@ class ServingApp:
             self.metrics.record_error("score")
             self._close_trace_error(trace)
             raise
+        # rtfd-lint: allow[wall-clock] HTTP serving plane is real-time (no virtual-clock mode)
         dt = time.perf_counter() - t0
         # batch metrics count the same population as per-prediction metrics:
         # fresh results only — a cache hit costs ~0 and would deflate the
@@ -462,11 +475,22 @@ class ServingApp:
             # SHED), so retriable overload is visible to the caller without
             # looking like record loss. The ladder observes the batcher
             # queue depth as its backlog signal.
+            # rtfd-lint: allow[wall-clock] HTTP serving plane is real-time (no virtual-clock mode)
             decision = self.qos.admit(txn, time.monotonic())
             if not decision.admitted:
                 return 200, self.qos.shed_result(txn, decision)
             self.qos.observe_backlog(self.batcher.queue_depth)
-            self.qos.apply_degradation(self.scorer)
+            # A served-rung change is only FLAGGED here: the event loop
+            # must never take _score_lock (an executor thread holds it
+            # across multi-ms batch assembly — blocking here would freeze
+            # every endpoint exactly when QoS is protecting latency). The
+            # executor consumes the flag in _dispatch_batch_sync under
+            # the lock it already holds, so set_degradation's mask +
+            # rules_only writes can never race a dispatch into a torn
+            # (mask from rung N, flag from rung N+1) pair — the
+            # `rtfd lint` lock-order finding this path was rebuilt for.
+            if self.qos.effective_level() != self.scorer.qos_level:
+                self._qos_rung_dirty = True
         timeout = self.config.serving.prediction_timeout_seconds
         self._admit(1)
         try:
@@ -476,6 +500,7 @@ class ServingApp:
             self.metrics.record_error("at_capacity")
             raise HttpError(503, "scoring queue full")
         self._release_on_done(fut, 1)
+        # rtfd-lint: allow[wall-clock] HTTP serving plane is real-time (no virtual-clock mode)
         t_enq = time.monotonic()
         try:
             # shield: the waiter's timeout must not cancel the scoring —
@@ -487,6 +512,7 @@ class ServingApp:
             self.metrics.record_error("timeout")
             raise HttpError(408, "prediction timed out")
         if self.qos.enabled:
+            # rtfd-lint: allow[wall-clock] HTTP serving plane is real-time (no virtual-clock mode)
             self.qos.record_completion(t_enq, time.monotonic())
         self.metrics.queue_depth.set(self.batcher.queue_depth)
         return 200, result
@@ -504,6 +530,7 @@ class ServingApp:
             raise HttpError(
                 413, f"batch of {len(txns)} exceeds the concurrency "
                      f"capacity {limit}; split into smaller batches")
+        # rtfd-lint: allow[wall-clock] HTTP serving plane is real-time (no virtual-clock mode)
         t0 = time.perf_counter()
         self._admit(len(txns))
         try:
@@ -515,6 +542,7 @@ class ServingApp:
         return 200, {
             "results": results,
             "count": len(results),
+            # rtfd-lint: allow[wall-clock] HTTP serving plane is real-time (no virtual-clock mode)
             "processing_time_ms": (time.perf_counter() - t0) * 1e3,
         }
 
@@ -525,6 +553,7 @@ class ServingApp:
             "status": "healthy",
             "models_loaded": loaded,
             "num_models": info["num_models"],
+            # rtfd-lint: allow[wall-clock] HTTP serving plane is real-time (no virtual-clock mode)
             "uptime_seconds": time.monotonic() - self._started,
             "queue_depth": self.batcher.queue_depth,
         }
@@ -738,6 +767,7 @@ class ServingApp:
                 raise HttpError(
                     422, "each label event needs transaction_id + is_fraud")
             ev = dict(ev)
+            # rtfd-lint: allow[wall-clock] HTTP serving plane is real-time (no virtual-clock mode)
             ev.setdefault("label_ts", time.time())
             cleaned.append(ev)
         with self._score_lock:

@@ -63,10 +63,16 @@ class RequestMicrobatcher:
         tracer=None,
         controller=None,
         classify_fn: Optional[Callable[[Mapping[str, Any]], str]] = None,
+        clock: Callable[[], float] = time.monotonic,
     ):
         self.score_fn = score_fn
         self.max_batch = max_batch
         self.deadline_s = deadline_ms / 1e3
+        # injected time base (clock-discipline): every deadline/queue-wait
+        # read below goes through this seam — time.monotonic in production,
+        # a virtual clock in deterministic tests. Must match the time base
+        # of the attached budget/tracer/controller.
+        self._clock = clock
         # optional qos.LatencyBudget: per-request enqueue timestamps bound
         # the close deadline by the oldest waiter's remaining budget
         self.budget = budget
@@ -144,7 +150,7 @@ class RequestMicrobatcher:
         if self._closed:
             raise RuntimeError("microbatcher is stopped")
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        now = time.monotonic()
+        now = self._clock()
         if self.controller is not None:
             self.controller.observe(now)
         self._queue.put_nowait((txn, fut, now))
@@ -155,7 +161,7 @@ class RequestMicrobatcher:
         if self._closed:
             raise RuntimeError("microbatcher is stopped")
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        now = time.monotonic()
+        now = self._clock()
         if self.controller is not None:
             self.controller.observe(now)
         await self._queue.put((txn, fut, now))
@@ -171,7 +177,7 @@ class RequestMicrobatcher:
         if self.controller is not None:
             deadline, kind = math.inf, "deadline"
         else:
-            deadline, kind = time.monotonic() + self.deadline_s, "deadline"
+            deadline, kind = self._clock() + self.deadline_s, "deadline"
         if self.budget is not None:
             by = self.budget.close_by(first_item[2])
             if by < deadline:
@@ -212,7 +218,7 @@ class RequestMicrobatcher:
             deadline, bound_kind = self._close_at(first)
             reason = "size"
             while len(batch) < self.max_batch:
-                now = time.monotonic()
+                now = self._clock()
                 remaining = deadline - now
                 if remaining <= 0:
                     reason = bound_kind
@@ -291,7 +297,7 @@ class RequestMicrobatcher:
         cb = getattr(self.controller, "on_batch_complete", None)
         if cb is None:
             return
-        now = time.monotonic()
+        now = self._clock()
         cb(n, max(0.0, now - t_dispatch), now,
            latencies_ms=[(now - t) * 1e3 for t in enq_ts])
 
@@ -302,7 +308,7 @@ class RequestMicrobatcher:
         txns = [t for t, _, _ in batch]
         futs = [f for _, f, _ in batch]
         trace = self._trace_for(batch)
-        t_disp = time.monotonic()
+        t_disp = self._clock()
         try:
             # device work off the event loop; one fused program per batch
             if trace is not None:
@@ -335,7 +341,7 @@ class RequestMicrobatcher:
         txns = [t for t, _, _ in batch]
         futs = [f for _, f, _ in batch]
         trace = self._trace_for(batch)
-        t_disp = time.monotonic()
+        t_disp = self._clock()
         try:
             if trace is not None:
                 ctx = await loop.run_in_executor(

@@ -37,6 +37,7 @@ class PredictionCache:
     def get(self, key: str, now: Optional[float] = None) -> Optional[Dict[str, Any]]:
         """Deep copy out: a caller mutating the served response (experiment
         annotation, downstream enrichment) must not corrupt the entry."""
+        # rtfd-lint: allow[wall-clock] default time base; callers pass now explicitly
         now = now if now is not None else time.monotonic()
         entry = self._data.get(key)
         if entry is None or now - entry[0] > self.ttl:
@@ -52,6 +53,7 @@ class PredictionCache:
         """Deep copy in: the stored response is frozen at serve time."""
         if not key:
             return
+        # rtfd-lint: allow[wall-clock] default time base; callers pass now explicitly
         now = now if now is not None else time.monotonic()
         self._data[key] = (now, copy.deepcopy(result))
         self._data.move_to_end(key)

@@ -47,6 +47,7 @@ class _DequeFallback:
             return True
 
     def next_batch(self, block_ms: int = 0) -> list:
+        # rtfd-lint: allow[wall-clock] real network/backpressure pacing
         deadline = time.monotonic() + block_ms / 1000.0
         while True:
             with self._lock:
@@ -55,6 +56,7 @@ class _DequeFallback:
                            for _ in range(min(len(self._dq),
                                               self._max_batch))]
                     return out
+            # rtfd-lint: allow[wall-clock] real network/backpressure pacing
             if time.monotonic() >= deadline:
                 return []
             time.sleep(0.001)
@@ -119,6 +121,7 @@ class IngressGateway:
         ``dropped`` counter only ever counts records actually lost."""
         if self.stamp_ingest:
             txn = dict(txn)
+            # rtfd-lint: allow[wall-clock] ingest stamp is wall-clock by contract (broker-lag attribution)
             txn["ingest_ts"] = time.time()
         payload = json.dumps(txn, separators=(",", ":")).encode()
         if self._slot_bytes is not None and len(payload) > self._slot_bytes:
@@ -156,7 +159,9 @@ class IngressGateway:
 
     def flush(self, timeout_s: float = 30.0) -> bool:
         """Block until everything submitted so far has been produced."""
+        # rtfd-lint: allow[wall-clock] real network/backpressure pacing
         deadline = time.monotonic() + timeout_s
+        # rtfd-lint: allow[wall-clock] real network/backpressure pacing
         while time.monotonic() < deadline:
             if self._q.pending() == 0 and self._idle.is_set():
                 return True

@@ -300,6 +300,7 @@ class StreamJob:
         trace_ctxs: List[Any] = []
         tracer = self.tracer
         batch_ids: set = set()
+        # rtfd-lint: allow[wall-clock] production default time base; drills pass now
         t_adm = now if now is not None else time.time()
 
         def _ingest_lag(rec: Record) -> float:
@@ -386,6 +387,7 @@ class StreamJob:
                 with self._stage.lock:
                     self.qos.apply_degradation(self.scorer)
             else:
+                # rtfd-lint: allow[lock-order] stream job is single-writer: consume, score, QoS share one thread
                 self.qos.apply_degradation(self.scorer)
         if not fresh:
             return _BatchCtx([], set(), None, positions, now, invalid,
@@ -433,6 +435,7 @@ class StreamJob:
         cfg = self.config
         fresh = ctx.fresh
         t_done = now if now is not None else (
+            # rtfd-lint: allow[wall-clock] production default time base; drills pass now
             ctx.now if ctx.now is not None else time.time())
         now = ctx.now
         if not fresh:
@@ -777,10 +780,12 @@ class StreamJob:
         """Process the stream for a wall-clock window (soak-test entry)."""
         from collections import deque
 
+        # rtfd-lint: allow[wall-clock] consume-only slice duration is wall-bound by definition
         t_end = time.monotonic() + duration_s
         start = self.counters["scored"]
         depth = self._inflight_depth()
         in_flight: deque = deque()
+        # rtfd-lint: allow[wall-clock] consume-only slice duration is wall-bound by definition
         while time.monotonic() < t_end:
             batch = self.assembler.next_batch(block=True, timeout_s=0.05)
             if batch:

@@ -555,6 +555,7 @@ class KafkaBroker:
             return parts
         # LEADER_NOT_AVAILABLE (5) while an auto-created topic elects a
         # leader is transient — retry with backoff before giving up
+        # rtfd-lint: allow[wall-clock] real-broker client: I/O deadlines and record timestamps
         deadline = time.monotonic() + min(self.timeout_s, 10.0)
         last_err = 3
         while True:
@@ -576,6 +577,7 @@ class KafkaBroker:
             parts = self._meta.get(topic)
             if parts:
                 return parts
+            # rtfd-lint: allow[wall-clock] real-broker client: I/O deadlines and record timestamps
             if last_err not in (5, 3) or time.monotonic() >= deadline:
                 raise KafkaProtocolError("Metadata", last_err)
             time.sleep(0.1)
@@ -597,6 +599,7 @@ class KafkaBroker:
     def produce(self, topic: str, value: Any, key: Optional[str] = None,
                 timestamp: Optional[float] = None) -> Record:
         part = self._pick_partition(topic, key)
+        # rtfd-lint: allow[wall-clock] real-broker client: I/O deadlines and record timestamps
         ts = timestamp if timestamp is not None else time.time()
         offset = self._produce_raw(topic, part, [(
             key.encode() if key is not None else None,
@@ -607,6 +610,7 @@ class KafkaBroker:
 
     def produce_batch(self, topic: str, values, key_fn=None) -> int:
         by_part: Dict[int, list] = {}
+        # rtfd-lint: allow[wall-clock] real-broker client: I/O deadlines and record timestamps
         now_ms = int(time.time() * 1000)
         n = 0
         for v in values:
@@ -624,6 +628,7 @@ class KafkaBroker:
         """(key, value) pairs batched into per-partition RecordBatches —
         same wire efficiency as produce_batch, explicit keys."""
         by_part: Dict[int, list] = {}
+        # rtfd-lint: allow[wall-clock] real-broker client: I/O deadlines and record timestamps
         now_ms = int(time.time() * 1000)
         n = 0
         for key, v in items:
@@ -683,6 +688,7 @@ class KafkaBroker:
                     return off
                 except (ConnectionError, OSError) as e:
                     last_exc = e
+                    # rtfd-lint: allow[lock-order] deliberate: the partition lock must span the idempotent retry (baseSequence must not interleave)
                     time.sleep(0.05 * (attempt + 1))
                     try:
                         self._conn.reconnect()

@@ -435,6 +435,7 @@ class FakeKafkaServer:
         heartbeating is removed; if the group was stable, that triggers a
         rebalance — the survivors' next heartbeat says REBALANCE_IN_PROGRESS
         and they rejoin to adopt the dead member's partitions."""
+        # rtfd-lint: allow[wall-clock] broker-protocol timeouts (real I/O even in the fake)
         now = time.monotonic()
         dead = [mid for mid, m in g.members.items()
                 if now - m["last_seen"] > m["session_ms"] / 1000.0]
@@ -463,6 +464,7 @@ class FakeKafkaServer:
             if g.state in ("empty", "stable", "awaiting_sync"):
                 g.state = "joining"
                 g.pending = {}
+                # rtfd-lint: allow[wall-clock] broker-protocol timeouts (real I/O even in the fake)
                 g.join_deadline = (time.monotonic()
                                    + min(rebalance_ms, 30_000) / 1000.0)
             # each member's OWN session timeout rides with its join — the
@@ -474,8 +476,10 @@ class FakeKafkaServer:
             while g.state == "joining":
                 known = set(g.members)
                 if (known <= set(g.pending)
+                        # rtfd-lint: allow[wall-clock] broker-protocol timeouts (real I/O even in the fake)
                         or time.monotonic() >= g.join_deadline):
                     g.generation += 1
+                    # rtfd-lint: allow[wall-clock] broker-protocol timeouts (real I/O even in the fake)
                     now = time.monotonic()
                     g.members = {
                         mid: {"last_seen": now, "session_ms": sess,
@@ -519,9 +523,11 @@ class FakeKafkaServer:
                 g.assignments = dict(assignments)
                 g.state = "stable"
                 g.cond.notify_all()
+            # rtfd-lint: allow[wall-clock] broker-protocol timeouts (real I/O even in the fake)
             deadline = time.monotonic() + 10.0
             while (g.state == "awaiting_sync"
                    and g.generation == generation
+                   # rtfd-lint: allow[wall-clock] broker-protocol timeouts (real I/O even in the fake)
                    and time.monotonic() < deadline):
                 g.cond.wait(timeout=0.05)
             if g.generation != generation or g.state == "joining":
@@ -530,6 +536,7 @@ class FakeKafkaServer:
             if g.state != "stable":
                 return (Writer().i16(ERR_REBALANCE_IN_PROGRESS)
                         .bytes_(b"").done())
+            # rtfd-lint: allow[wall-clock] broker-protocol timeouts (real I/O even in the fake)
             g.members[member_id]["last_seen"] = time.monotonic()
             return (Writer().i16(0)
                     .bytes_(g.assignments.get(member_id, b"")).done())
@@ -542,6 +549,7 @@ class FakeKafkaServer:
             self._evict_dead(g)
             if member_id not in g.members:
                 return Writer().i16(ERR_UNKNOWN_MEMBER_ID).done()
+            # rtfd-lint: allow[wall-clock] broker-protocol timeouts (real I/O even in the fake)
             g.members[member_id]["last_seen"] = time.monotonic()
             if generation != g.generation:
                 return Writer().i16(ERR_ILLEGAL_GENERATION).done()
@@ -560,6 +568,7 @@ class FakeKafkaServer:
                 if g.state == "stable":
                     g.state = "joining" if g.members else "empty"
                     g.pending = {}
+                    # rtfd-lint: allow[wall-clock] broker-protocol timeouts (real I/O even in the fake)
                     g.join_deadline = time.monotonic() + 10.0
                 g.cond.notify_all()
         return Writer().i16(0).done()

@@ -130,6 +130,7 @@ class GroupMembership:
         with self.lock:
             if self.generation >= 0:
                 return False
+            # rtfd-lint: allow[wall-clock] group-membership heartbeats/deadlines are real time
             deadline = (time.monotonic()
                         + self.rebalance_timeout_ms / 1000.0 * 2)
             while True:
@@ -139,10 +140,12 @@ class GroupMembership:
                     return True
                 except KafkaProtocolError as e:
                     if (e.code not in _REJOIN_ERRORS
+                            # rtfd-lint: allow[wall-clock] group-membership heartbeats/deadlines are real time
                             or time.monotonic() > deadline):
                         raise
                     if e.code == ERR_UNKNOWN_MEMBER_ID:
                         self.member_id = ""
+                    # rtfd-lint: allow[lock-order] deliberate: rejoin backoff holds the membership lock (no concurrent join/heartbeat allowed)
                     time.sleep(0.05)
 
     def _join_sync(self) -> None:
@@ -289,6 +292,7 @@ class KafkaGroupConsumer:
         while not self._closed.wait(self.heartbeat_interval_s):
             try:
                 self.membership.heartbeat()
+                # rtfd-lint: allow[wall-clock] group-membership heartbeats/deadlines are real time
                 self._last_heartbeat = time.monotonic()
             except (KafkaProtocolError, ConnectionError, OSError):
                 pass                      # next poll's _maintain recovers
@@ -296,6 +300,7 @@ class KafkaGroupConsumer:
     # ---------------------------------------------------------- assignment
     def _maintain(self) -> None:
         """Heartbeat on cadence; rejoin + reset positions on rebalance."""
+        # rtfd-lint: allow[wall-clock] group-membership heartbeats/deadlines are real time
         now = time.monotonic()
         if now - self._last_heartbeat >= self.heartbeat_interval_s:
             self._last_heartbeat = now

@@ -289,6 +289,7 @@ class BrokerServer:
         with self._io_lock:
             planned = [
                 (b.select_partition(topic, k), k, v,
+                 # rtfd-lint: allow[wall-clock] record-timestamp default; callers pass ts
                  ts if ts is not None else time.time())
                 for k, v, ts in items
             ]

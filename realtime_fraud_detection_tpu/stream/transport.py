@@ -138,6 +138,7 @@ class InMemoryBroker:
         log = self._logs(topic)[partition]
         with log.lock:
             rec = Record(topic, partition, len(log.records), key, value,
+                         # rtfd-lint: allow[wall-clock] record-timestamp default; callers pass ts
                          timestamp if timestamp is not None else time.time())
             log.records.append(rec)
         return rec

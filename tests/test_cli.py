@@ -20,6 +20,8 @@ class TestParser:
         ["serve", "--port", "9999"],
         ["train", "--rows", "500"],
         ["bench"],
+        ["lint", "--format", "json"],
+        ["lint", "--lockwatch", "--fast"],
         ["health-check", "--url", "http://x"],
         ["topics"],
     ])

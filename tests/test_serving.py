@@ -99,6 +99,42 @@ class TestRequestMicrobatcher:
 
         asyncio.run(main())
 
+    def test_injected_clock_drives_deadline_close(self):
+        """Regression pin for the ISSUE 7 clock-discipline fix: the
+        batcher's deadline logic reads its injected clock, never bare
+        time.monotonic. With a 60 s configured window, a virtual clock
+        leaping past the deadline must close the batch in ~zero real
+        time — under the old bare-monotonic code this test times out."""
+        import asyncio
+        import time as _t
+
+        vnow = [100.0]
+
+        def fake_score(txns):
+            return [dict(t) for t in txns]
+
+        async def main():
+            b = RequestMicrobatcher(fake_score, max_batch=64,
+                                    deadline_ms=60_000.0,
+                                    clock=lambda: vnow[0])
+            await b.start()
+            fut0 = b.submit_nowait({"i": 0})
+            await asyncio.sleep(0.05)     # drain loop is inside the window
+            vnow[0] += 120.0              # virtual clock leaps past it
+            fut1 = b.submit_nowait({"i": 1})  # wakes the drain loop
+            t0 = _t.monotonic()
+            results = await asyncio.wait_for(
+                asyncio.gather(fut0, fut1), timeout=10.0)
+            real_s = _t.monotonic() - t0
+            reasons = dict(b.close_reasons)
+            await b.stop()
+            return results, real_s, reasons
+
+        results, real_s, reasons = asyncio.run(main())
+        assert results == [{"i": 0}, {"i": 1}]
+        assert real_s < 5.0               # nowhere near the 60 s window
+        assert reasons.get("deadline", 0) >= 1
+
     def test_submit_racing_stop_does_not_hang(self):
         import asyncio
 
@@ -515,6 +551,34 @@ class TestEndpoints:
             assert "qos_shed_total" in text
             assert 'priority="low"' in text
         finally:
+            status, _ = _request(app.port, "POST", "/qos",
+                                 {"enabled": False, "admission_rate": 0.0})
+            assert status == 200
+
+    def test_predict_applies_rung_change_to_scorer(self, app_server):
+        """ISSUE 7 review fix: _predict pushes a ladder-rung CHANGE into
+        the scorer (under the score lock) and skips the lock entirely
+        while the rung is steady — the served level must still track the
+        plane's effective level through the real HTTP path."""
+        app, gen = app_server
+        status, _ = _request(app.port, "POST", "/qos",
+                             {"enabled": True, "admission_rate": 0.0})
+        assert status == 200
+        try:
+            assert app.scorer.qos_level == 0
+            app.qos.slo_engaged = True       # floors the served rung at 1
+            high = dict(_txn(gen), amount=5000.0)
+            status, _res = _request(app.port, "POST", "/predict", high)
+            assert status == 200
+            assert app.qos.effective_level() == 1
+            assert app.scorer.qos_level == 1
+            app.qos.slo_engaged = False      # gate releases: rung recovers
+            status, _res = _request(app.port, "POST", "/predict",
+                                    dict(_txn(gen), amount=5000.0))
+            assert status == 200
+            assert app.scorer.qos_level == 0
+        finally:
+            app.qos.slo_engaged = False
             status, _ = _request(app.port, "POST", "/qos",
                                  {"enabled": False, "admission_rate": 0.0})
             assert status == 200
