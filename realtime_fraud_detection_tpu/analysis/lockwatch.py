@@ -3,7 +3,8 @@
 The static ``lock-order`` rule (analysis/lint.py) sees the lexical
 structure; this module watches what the threads actually do. While any of
 the deterministic drills run (``rtfd lint --lockwatch`` drives pool-drill,
-trace-drill, autotune-drill, feedback-drill and qos-drill), every
+trace-drill, autotune-drill, feedback-drill, qos-drill and chaos-drill),
+every
 ``threading.Lock`` / ``RLock`` / ``Condition`` created from package code
 is replaced by an instrumented wrapper that records, per thread:
 
@@ -44,9 +45,9 @@ _REAL_CONDITION = threading.Condition
 
 PACKAGE_MARKER = "realtime_fraud_detection_tpu"
 
-# the five deterministic drills the watcher is validated against
+# the six deterministic drills the watcher is validated against
 LOCKWATCH_DRILLS = ("qos-drill", "trace-drill", "autotune-drill",
-                    "feedback-drill", "pool-drill")
+                    "feedback-drill", "pool-drill", "chaos-drill")
 
 
 class LockWatcher:
@@ -374,10 +375,10 @@ def run_drill_watched(drill: str, fast: bool = True,
     """Run one deterministic drill under the watcher; return
     ``{"drill", "drill_passed", "lockwatch": report}``.
 
-    pool-drill needs a multi-device host platform — callers (the
-    ``rtfd lint --lockwatch`` parent) re-exec it into a child with
-    ``XLA_FLAGS=--xla_force_host_platform_device_count=N``; the other
-    four run on whatever platform is live.
+    pool-drill and chaos-drill need a multi-device host platform —
+    callers (the ``rtfd lint --lockwatch`` parent) re-exec them into a
+    child with ``XLA_FLAGS=--xla_force_host_platform_device_count=N``;
+    the other four run on whatever platform is live.
     """
     import contextlib
     import io
@@ -422,7 +423,7 @@ def run_drill_watched(drill: str, fast: bool = True,
                 cfg = (FeedbackDrillConfig.fast() if fast
                        else FeedbackDrillConfig())
                 passed = bool(run_feedback_drill(cfg)["passed"])
-            else:   # pool-drill
+            elif drill == "pool-drill":
                 from realtime_fraud_detection_tpu.scoring.pool_drill import (
                     PoolDrillConfig,
                     run_pool_drill,
@@ -430,4 +431,20 @@ def run_drill_watched(drill: str, fast: bool = True,
 
                 cfg = (PoolDrillConfig.fast() if fast else PoolDrillConfig())
                 passed = bool(run_pool_drill(cfg)["passed"])
+            else:   # chaos-drill
+                import dataclasses
+
+                from realtime_fraud_detection_tpu.chaos.drill import (
+                    ChaosDrillConfig,
+                    run_chaos_drill,
+                )
+
+                # one pass at the drill's own default seed: lock/thread
+                # behavior is identical on the replay run, so the
+                # bit-identical re-run would only double the watcher's
+                # wall time (determinism is the drill's OWN acceptance)
+                cfg = dataclasses.replace(
+                    ChaosDrillConfig.fast() if fast else ChaosDrillConfig(),
+                    replay_check=False)
+                passed = bool(run_chaos_drill(cfg)["passed"])
     return {"drill": drill, "drill_passed": passed, "lockwatch": w.report()}

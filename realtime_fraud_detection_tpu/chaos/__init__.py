@@ -1,0 +1,31 @@
+"""Chaos plane: deterministic fault injection + adversarial scenarios.
+
+Every other plane in this framework was proven by its own deterministic
+drill *in isolation*; this package makes correlated failure a first-class,
+replayable input. ``chaos.faults`` schedules named fault windows on the
+drills' virtual clock and binds them to per-layer injectors (broker
+replica outage, consumer-group member kill, device-replica death, slow
+device, label stall, flash crowd); ``chaos.drill`` composes them — plus
+the coordinated fraud ring from ``sim.fraud_patterns`` — into the
+``rtfd chaos-drill`` combined recovery drill.
+"""
+
+from realtime_fraud_detection_tpu.chaos.faults import (
+    BrokerReplicaOutage,
+    ChaosPlan,
+    ConsumerMemberKill,
+    DeviceReplicaDeath,
+    FaultWindow,
+    LabelStall,
+    SlowDevice,
+)
+
+__all__ = [
+    "BrokerReplicaOutage",
+    "ChaosPlan",
+    "ConsumerMemberKill",
+    "DeviceReplicaDeath",
+    "FaultWindow",
+    "LabelStall",
+    "SlowDevice",
+]

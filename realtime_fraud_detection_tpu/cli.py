@@ -1022,9 +1022,89 @@ def _pool_drill_inprocess(args: argparse.Namespace) -> int:
     return 0 if summary["passed"] else 1
 
 
+def cmd_chaos_drill(args: argparse.Namespace) -> int:
+    """Deterministic combined recovery drill (chaos/drill.py): one seeded
+    virtual-clock timeline layering a flash crowd, a broker replica outage
+    (real NotEnoughReplicas window + add_replica backfill), device-pool
+    replica death + slow device, a label-stream stall, and a coordinated
+    fraud ring — proving the QoS/tracing/pool/feedback planes hold
+    TOGETHER: zero high-value sheds, effectively-once across the outage,
+    ladder + SLO burn recovery, pool retries with FIFO intact, ring AUC
+    retrained back past baseline via a gate-passed promotion, and a second
+    run replaying bit-identically. Prints the full summary, then a compact
+    (<2 KB) verdict as the FINAL stdout line (bench.py convention). Exit 1
+    unless every check passed.
+
+    Always re-execs onto a virtual N-device CPU host platform (the
+    pool-drill wedge-proofing recipe: the parent never initializes a
+    backend, so a wedged TPU relay can't stall the drill, and the verdict
+    is identical on every box).
+    """
+    import subprocess
+
+    if os.environ.get("_RTFD_CHAOS_DRILL_CHILD") == "1":
+        return _chaos_drill_inprocess(args)
+    from realtime_fraud_detection_tpu.chaos.drill import ChaosDrillConfig
+
+    devices = args.devices or (ChaosDrillConfig.fast().n_devices
+                               if args.fast else ChaosDrillConfig().n_devices)
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    flags = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if not f.startswith("--xla_force_host_platform_device_count"))
+    env["XLA_FLAGS"] = (
+        f"{flags} --xla_force_host_platform_device_count="
+        f"{devices}").strip()
+    env["JAX_PLATFORMS"] = "cpu"
+    env["_RTFD_CHAOS_DRILL_CHILD"] = "1"
+    argv = [sys.executable, "-m", "realtime_fraud_detection_tpu",
+            "chaos-drill", "--devices", str(devices)]
+    if args.seed is not None:       # explicit flag wins over chaos.seed
+        argv += ["--seed", str(args.seed)]
+    if args.config:
+        argv += ["--config", args.config]
+    if args.fast:
+        argv.append("--fast")
+    if args.no_replay:
+        argv.append("--no-replay")
+    proc = subprocess.run(argv, env=env, timeout=540)
+    return proc.returncode
+
+
+def _chaos_drill_inprocess(args: argparse.Namespace) -> int:
+    import dataclasses as _dc
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from realtime_fraud_detection_tpu.chaos.drill import (
+        ChaosDrillConfig,
+        apply_chaos_settings,
+        compact_chaos_summary,
+        run_chaos_drill,
+    )
+
+    cfg = ChaosDrillConfig.fast() if args.fast else ChaosDrillConfig()
+    if args.config:
+        from realtime_fraud_detection_tpu.utils.config import Config
+
+        cfg = apply_chaos_settings(cfg, Config.from_file(args.config).chaos)
+    cfg = _dc.replace(cfg, replay_check=not args.no_replay,
+                      **({"seed": args.seed}
+                         if args.seed is not None else {}),
+                      **({"n_devices": args.devices} if args.devices else {}))
+    summary = run_chaos_drill(cfg)
+    print(json.dumps(summary), flush=True)
+    print(json.dumps(compact_chaos_summary(summary),
+                     separators=(",", ":")), flush=True)
+    return 0 if summary["passed"] else 1
+
+
 def cmd_lint(args: argparse.Namespace) -> int:
     """Run the repo-native invariant checker (analysis/lint.py) — or, with
-    --lockwatch, the dynamic lock-order watcher under all five
+    --lockwatch, the dynamic lock-order watcher under all six
     deterministic drills (analysis/lockwatch.py). Exit 0 only when clean.
 
     The static rules (wall-clock, d2h, metrics, lock-order, determinism,
@@ -1036,8 +1116,8 @@ def cmd_lint(args: argparse.Namespace) -> int:
     """
     if getattr(args, "lockwatch_run", ""):
         # child mode (one drill, one process): emits a single JSON line.
-        # pool-drill children are launched with the virtual 8-device host
-        # platform env by the parent below.
+        # pool-drill / chaos-drill children are launched with the virtual
+        # 8-device host platform env by the parent below.
         from realtime_fraud_detection_tpu.analysis.lockwatch import (
             run_drill_watched,
         )
@@ -1070,7 +1150,7 @@ def _lockwatch_all_drills(args: argparse.Namespace) -> int:
     ok = True
     for drill in LOCKWATCH_DRILLS:
         env = dict(os.environ)
-        if drill == "pool-drill":
+        if drill in ("pool-drill", "chaos-drill"):
             env.pop("PALLAS_AXON_POOL_IPS", None)
             flags = " ".join(
                 f for f in env.get("XLA_FLAGS", "").split()
@@ -1483,6 +1563,26 @@ def build_parser() -> argparse.ArgumentParser:
                     help="per-replica in-flight batches")
     sp.add_argument("--seed", type=int, default=7)
     sp.set_defaults(fn=cmd_pool_drill)
+
+    sp = sub.add_parser("chaos-drill",
+                        help="deterministic combined recovery drill: "
+                             "flash crowd + broker outage + device faults "
+                             "+ fraud ring on one virtual-clock timeline")
+    sp.add_argument("--fast", action="store_true",
+                    help="tier-1 sizes (the CI smoke configuration)")
+    sp.add_argument("--devices", type=int, default=0,
+                    help="virtual host-platform device count for the pool "
+                         "(0 = the config's default: 4 full, 2 fast)")
+    sp.add_argument("--seed", type=int, default=None,
+                    help="timeline seed (default: chaos.seed from --config "
+                         "if given, else 11)")
+    sp.add_argument("--config", default="",
+                    help="JSON config file; the chaos.* block reshapes the "
+                         "fault timeline (outage/stall windows, flash "
+                         "multipliers, ring shape)")
+    sp.add_argument("--no-replay", action="store_true",
+                    help="skip the second bit-identical replay run")
+    sp.set_defaults(fn=cmd_chaos_drill)
 
     sp = sub.add_parser("lint",
                         help="repo-native invariant checker (static rules "

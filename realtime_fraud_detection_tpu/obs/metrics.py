@@ -92,6 +92,13 @@ class Counter(_Metric):
     def value(self, **labels: str) -> float:
         return self._values.get(_labels_key(labels), 0.0)
 
+    def by_label(self) -> List[Tuple[Dict[str, str], float]]:
+        """Sorted snapshot of (labels, value) pairs — the public accessor
+        for folding a labeled counter (e.g. the drills' shed-by-
+        priority:reason tables) without reaching into ``_values``."""
+        with self._lock:
+            return [(dict(k), v) for k, v in sorted(self._values.items())]
+
     def total(self) -> float:
         with self._lock:
             return sum(self._values.values())
@@ -518,6 +525,21 @@ class MetricsCollector:
             "autotune_frozen",
             "1 while the tuner is frozen by the QoS ladder / SLO burn")
         self._autotune_seen: Dict[Tuple[str, str], float] = {}
+        # chaos plane (chaos/): scheduled fault windows and recovery
+        # accounting — mirrored from ChaosPlan.snapshot() by sync_chaos at
+        # exposition time (honest counter deltas, same discipline as every
+        # sync_* mirror above)
+        self.chaos_fault_windows = r.counter(
+            "chaos_fault_windows_total",
+            "Fault windows opened by the chaos plane", ("fault",))
+        self.chaos_fault_active = r.gauge(
+            "chaos_fault_active",
+            "1 while the named fault window is open", ("fault",))
+        self.chaos_recovery_seconds = r.gauge(
+            "chaos_recovery_seconds",
+            "Virtual seconds from a fault window's end to observed plane "
+            "recovery", ("fault",))
+        self._chaos_seen: Dict[str, float] = {}
 
     def sync_host_stats(self, host_stats: Mapping[str, Any]) -> None:
         """Mirror ``FraudScorer.host_stats()`` into the Prometheus series.
@@ -706,6 +728,24 @@ class MetricsCollector:
         self.autotune_inflight_depth.set(
             float(tuner.get("inflight_depth", 0)))
         self.autotune_frozen.set(1.0 if tuner.get("frozen") else 0.0)
+
+    def sync_chaos(self, snapshot: Mapping[str, Any]) -> None:
+        """Mirror a ``chaos.ChaosPlan.snapshot()`` into the chaos_*
+        series. Called at exposition time (the plan's poll path never
+        touches the metrics lock); window-open counts mirror as deltas
+        against last-seen values — the same honest-counter scheme as
+        every other sync_* mirror."""
+        for w in snapshot.get("windows") or ():
+            fault = str(w.get("fault", "?"))
+            opened = 1.0 if w.get("begun") else 0.0
+            delta = opened - self._chaos_seen.get(fault, 0.0)
+            if delta > 0:
+                self.chaos_fault_windows.inc(delta, fault=fault)
+            self._chaos_seen[fault] = opened
+            self.chaos_fault_active.set(
+                1.0 if w.get("active") else 0.0, fault=fault)
+        for fault, rec_s in (snapshot.get("recovery_s") or {}).items():
+            self.chaos_recovery_seconds.set(float(rec_s), fault=str(fault))
 
     # ------------------------------------------------------------- recording
     def record_prediction(self, decision: str, fraud_score: float,

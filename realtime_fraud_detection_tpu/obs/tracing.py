@@ -289,6 +289,11 @@ class Tracer:
             "started": 0, "completed": 0, "shed": 0, "errors": 0,
             "cached": 0,
         }
+        # active fault-window attribution (chaos plane): while set, every
+        # trace closed — scored, shed, errored, terminal — carries
+        # ``meta["fault"]``, so a flight-recorder window spanning an
+        # injected outage separates in-fault tails from steady state
+        self.fault_context: str = ""
         self.slo = SloTracker(
             objective_ms=s.slo_objective_ms,
             objective_frac=s.slo_objective_frac,
@@ -322,6 +327,13 @@ class Tracer:
             return None
         return TraceBatch(self, ctxs, meta)
 
+    def set_fault_context(self, name: str) -> None:
+        """Chaos-plane attribution: set (or clear, with "") the active
+        fault-window name(s); subsequent trace completions — terminal
+        sheds/errors included — carry it as ``meta["fault"]``, so the
+        flight recorder separates fault-window tails from steady state."""
+        self.fault_context = str(name or "")
+
     # ------------------------------------------------------------ completion
     def finish_batch(self, trace: Optional[TraceBatch],
                      terminal: str = "scored") -> None:
@@ -335,6 +347,9 @@ class Tracer:
         if trace is None:
             return
         now = self._clock()
+        if self.fault_context:
+            trace.meta = dict(trace.meta)
+            trace.meta["fault"] = self.fault_context
         marks = trace.marks
         completed: List[CompletedTrace] = []
         for ctx in trace.contexts:
@@ -370,9 +385,12 @@ class Tracer:
         stages = {"queue": max(0.0, now - ctx.t_admit) * 1e3}
         if ctx.ingest_lag_s > 0.0:
             stages["ingest"] = ctx.ingest_lag_s * 1e3
+        meta = dict(meta)
+        if self.fault_context:
+            meta.setdefault("fault", self.fault_context)
         ct = CompletedTrace(ctx.trace_id, ctx.txn_id,
                             ctx.t_admit - ctx.ingest_lag_s, e2e_ms, stages,
-                            dict(meta), terminal, ctx.priority)
+                            meta, terminal, ctx.priority)
         with self._lock:
             self._record_locked(ct, now)
 

@@ -97,6 +97,8 @@ class _Replica:
         self.failures = 0           # fetch failures observed ON this replica
         self.queue_wait_s = 0.0
         self.fail_next = 0          # test fault injection (see inject_fault)
+        self.slow_next = 0          # slow-device injection (inject_slow)
+        self.slow_s = 0.0           # per-injected-fetch added delay
         self._mv_cache: Optional[tuple] = None  # (host mask, device copy)
 
     def mv_dev(self, mv: np.ndarray):
@@ -255,6 +257,7 @@ class DevicePool:
         attempts = len(self.replicas) + 1
         for _ in range(attempts):
             rep = self.replicas[token.replica_idx]
+            self._maybe_slow(rep)
             try:
                 if rep.fail_next > 0:
                     rep.fail_next -= 1
@@ -307,6 +310,7 @@ class DevicePool:
         import jax
 
         rep = self.replicas[token.replica_idx]
+        self._maybe_slow(rep)
         try:
             if rep.fail_next > 0:
                 rep.fail_next -= 1
@@ -338,11 +342,46 @@ class DevicePool:
         with self._cv:
             self.replicas[replica_idx].fail_next += n
 
+    def inject_slow(self, replica_idx: int, delay_s: float,
+                    n: int = 1) -> None:
+        """Chaos hook: the next ``n`` result fetches on a replica take an
+        extra ``delay_s`` — a DELAYED device, not a dead one. The batch
+        still completes on its own replica (no retry, no health change);
+        what must hold is FIFO completion across the pool while one
+        replica lags (pinned in tests/test_device_pool.py)."""
+        with self._cv:
+            rep = self.replicas[replica_idx]
+            rep.slow_next += max(0, int(n))
+            # rtfd-lint: allow[d2h] delay_s is a host scalar argument, not a device value
+            rep.slow_s = float(delay_s)
+
+    def _maybe_slow(self, rep: "_Replica") -> None:
+        """Apply an injected slow-device delay OUTSIDE the pool lock (a
+        stalled fetch must not block dispatch to healthy replicas)."""
+        # lock-free fast path: this runs on EVERY pooled result fetch, and
+        # slow_next is nonzero only while a chaos harness has armed
+        # inject_slow — a stale read at worst delays one injection by a
+        # fetch, so production fetches never contend on the pool CV here
+        if rep.slow_next <= 0:
+            return
+        with self._cv:
+            if rep.slow_next <= 0:
+                return
+            rep.slow_next -= 1
+            delay = rep.slow_s
+        time.sleep(delay)
+
     def revive(self, replica_idx: int) -> None:
         """Re-admit a failed replica to the rotation (operator action
-        after the underlying device recovers)."""
+        after the underlying device recovers). A revived device is a
+        HEALTHY device: any still-armed injected faults/delays are
+        cleared — a stale arm must not re-kill the replica after its
+        fault window closed."""
         with self._cv:
-            self.replicas[replica_idx].healthy = True
+            rep = self.replicas[replica_idx]
+            rep.healthy = True
+            rep.fail_next = 0
+            rep.slow_next = 0
             self._cv.notify_all()
 
     # ---------------------------------------------------------------- stats

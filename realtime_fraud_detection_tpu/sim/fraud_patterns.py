@@ -231,3 +231,117 @@ def _random_public_ip(rng: np.random.Generator) -> str:
     if octets[0] in (10, 192, 172, 127):
         octets[0] = 52
     return ".".join(str(int(o)) for o in octets)
+
+
+# ---------------------------------------------------------------------------
+# coordinated fraud ring (the chaos plane's adversarial scenario)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class FraudRingConfig:
+    """Shape of a coordinated fraud ring: one attacker operating many
+    compromised accounts through a SHARED, small entity set."""
+
+    n_members: int = 24       # compromised user cohort
+    n_merchants: int = 6      # complicit merchant set (one benign category)
+    n_devices: int = 4        # shared device fingerprints (the attacker's)
+    n_ips: int = 3            # shared egress IPs
+    rate: float = 0.08        # fraction of the stream that is ring traffic
+    merchant_category: str = "grocery"   # camouflage category
+
+    def validate(self) -> None:
+        if not 0.0 <= self.rate <= 1.0:
+            raise ValueError(f"ring rate must be in [0, 1], got {self.rate}")
+        if min(self.n_members, self.n_merchants, self.n_devices,
+               self.n_ips) < 1:
+            raise ValueError("ring needs >= 1 member/merchant/device/ip")
+
+
+class FraudRing:
+    """Stateful coordinated-ring applier over transaction dicts.
+
+    Every per-user drift/fraud pattern above is INDEPENDENT across users —
+    nothing in the simulator ever exercised the shared-entity structure
+    the paper's GraphSAGE branch exists for. A ring is the opposite shape:
+    a user COHORT funnels transactions through a handful of shared
+    merchants, device fingerprints and egress IPs. Each transaction is
+    deliberately in-distribution per feature — the member's own ordinary
+    amount, a mainstream rail, a benign prior score, near-home geo — so
+    neither the leaky prior feature nor an anomaly detector gets a free
+    win. The learnable signature is the CONJUNCTION (camouflage-category
+    merchant x a device fingerprint outside the member's enrolled list),
+    plus the shared-entity links (same devices/merchants/IPs across many
+    users) that the graph branch can consume. A model must be retrained
+    on labeled ring examples to rank it — which is exactly what
+    ``rtfd chaos-drill`` proves the feedback plane does.
+
+    Membership is drawn deterministically from the injected rng, so a
+    seeded drill replays the identical ring bit-for-bit.
+    """
+
+    def __init__(self, config: FraudRingConfig, users,
+                 merchant_ids: np.ndarray,
+                 merchant_categories: np.ndarray,
+                 rng: np.random.Generator):
+        config.validate()
+        self.config = config
+        self.users = users              # sim.simulator.UserPool
+        member_idx = rng.choice(users.n,
+                                size=min(config.n_members, users.n),
+                                replace=False)
+        self.member_idx = np.sort(member_idx)
+        self.member_ids = users.ids[self.member_idx]
+        in_cat = merchant_ids[merchant_categories
+                              == config.merchant_category]
+        if len(in_cat) == 0:
+            in_cat = merchant_ids
+        self.merchant_ids = in_cat[:config.n_merchants]
+        self.device_ids = [f"ringdev_{int(rng.integers(0, 2**32)):08x}"
+                           for _ in range(config.n_devices)]
+        self.ips = [_random_public_ip(rng) for _ in range(config.n_ips)]
+        self.rng = rng
+        self.applied = 0
+
+    def apply(self, txn: Dict[str, Any]) -> Dict[str, Any]:
+        """Rewrite one transaction as ring traffic. The member keeps their
+        OWN spend profile — amount drawn from the member's average (the
+        generator's own noise model) and geo near the member's home, so
+        per-user velocity/z-score/home-distance features stay in
+        distribution; only the entity linkage changes."""
+        rng = self.rng
+        u = int(self.member_idx[int(rng.integers(0,
+                                                 len(self.member_idx)))])
+        txn["user_id"] = str(self.users.ids[u])
+        txn["amount"] = max(1.0, round(
+            float(self.users.avg_amount[u])
+            * float(rng.normal(1.0, 0.3)) * float(rng.normal(1.0, 0.2)), 2))
+        txn["geolocation"] = {
+            "lat": float(self.users.home_lat[u] + rng.normal(0, 0.5)),
+            "lon": float(self.users.home_lon[u] + rng.normal(0, 0.5)),
+        }
+        txn["merchant_id"] = str(
+            self.merchant_ids[int(rng.integers(0, len(self.merchant_ids)))])
+        device = self.device_ids[int(rng.integers(0, len(self.device_ids)))]
+        txn["device_id"] = device
+        txn["device_fingerprint"] = device
+        txn["ip_address"] = self.ips[int(rng.integers(0, len(self.ips)))]
+        txn["is_fraud"] = True
+        txn["fraud_type"] = "fraud_ring"
+        # benign-looking prior: the incumbent has no reason to flag it
+        txn["fraud_score"] = float(rng.uniform(0.0, 0.3))
+        txn["fraud_reason"] = (
+            "coordinated ring (shared devices/merchants/IPs across cohort)")
+        self.applied += 1
+        return txn
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "members": len(self.member_ids),
+            "merchants": len(self.merchant_ids),
+            "devices": len(self.device_ids),
+            "ips": len(self.ips),
+            "category": self.config.merchant_category,
+            "rate": self.config.rate,
+            "applied": self.applied,
+        }

@@ -195,6 +195,10 @@ class TransactionGenerator:
         # incumbent models never trained on — 0.0 = off (default)
         self._drift_rate = 0.0
         self._drift_merchants: np.ndarray | None = None
+        # coordinated fraud ring (inject_fraud_ring): a user cohort
+        # funneling traffic through shared merchants/devices/IPs — the
+        # adversarial scenario the chaos drill retrains against. None = off
+        self._ring = None
 
     # ------------------------------------------------------------------ dicts
     def generate_batch(self, n: int) -> List[Dict[str, Any]]:
@@ -279,6 +283,9 @@ class TransactionGenerator:
             self.patterns.record_location(txn["user_id"], geo)
         if self._drift_rate > 0.0 and rng.random() < self._drift_rate:
             txn = self._apply_drifted_pattern(txn)
+        if self._ring is not None \
+                and rng.random() < self._ring.config.rate:
+            txn = self._ring.apply(txn)
         return txn
 
     # ------------------------------------------------------------ drift
@@ -308,6 +315,29 @@ class TransactionGenerator:
 
     def clear_drift(self) -> None:
         self._drift_rate = 0.0
+
+    # ------------------------------------------------------------ fraud ring
+    def inject_fraud_ring(self, config=None) -> "Any":
+        """Activate a coordinated fraud ring (sim/fraud_patterns.FraudRing):
+        a deterministic user cohort starts funneling a ``config.rate``
+        fraction of the stream through a small shared merchant/device/IP
+        set. Each ring transaction is in-distribution per feature; the
+        signal is the shared-entity conjunction — the adversarial scenario
+        that exercises the graph-side capability and drives the chaos
+        drill's retrain-to-baseline acceptance. Returns the live ring (for
+        stats / membership assertions)."""
+        from realtime_fraud_detection_tpu.sim.fraud_patterns import (
+            FraudRing,
+            FraudRingConfig,
+        )
+
+        cfg = config or FraudRingConfig()
+        self._ring = FraudRing(cfg, self.users, self.merchants.ids,
+                               self.merchants.category, self.rng)
+        return self._ring
+
+    def clear_fraud_ring(self) -> None:
+        self._ring = None
 
     def _apply_drifted_pattern(self, txn: Dict[str, Any]) -> Dict[str, Any]:
         rng = self.rng

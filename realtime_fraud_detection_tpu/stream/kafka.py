@@ -508,8 +508,23 @@ class KafkaBroker:
     def __init__(self, bootstrap: str = "127.0.0.1:9092",
                  client_id: str = "rtfd-tpu", acks: int = -1,
                  timeout_s: float = 30.0, idempotent: bool = False,
-                 compression: Optional[str] = None):
+                 compression: Optional[str] = None,
+                 retry_sleep=None):
+        from realtime_fraud_detection_tpu.utils.backoff import (
+            DeterministicBackoff,
+            instance_seed,
+        )
+
         host, _, port = bootstrap.partition(":")
+        # produce-retry schedule: bounded exponential + deterministic
+        # jitter, seeded per client INSTANCE (most callers share the
+        # default client_id, and those are exactly the producers whose
+        # retry storms must de-synchronize); ``retry_sleep`` is the
+        # injected seam (tests / the chaos plane pass a recording or
+        # virtual-clock sleep)
+        self._backoff = DeterministicBackoff(
+            base_s=0.05, mult=2.0, max_s=0.8,
+            seed=instance_seed(client_id), sleep=retry_sleep)
         self.acks = acks                         # -1 == acks=all (reference)
         self.timeout_s = timeout_s
         # producer-side codec (reference compression.type=lz4,
@@ -688,8 +703,12 @@ class KafkaBroker:
                     return off
                 except (ConnectionError, OSError) as e:
                     last_exc = e
-                    # rtfd-lint: allow[lock-order] deliberate: the partition lock must span the idempotent retry (baseSequence must not interleave)
-                    time.sleep(0.05 * (attempt + 1))
+                    # The partition lock deliberately spans this retry wait
+                    # (baseSequence must not interleave); the wait itself
+                    # goes through the injected backoff seam — bounded
+                    # exponential with deterministic jitter, virtualizable
+                    # by tests/drills instead of a fixed bare sleep.
+                    self._backoff.sleep(attempt)
                     try:
                         self._conn.reconnect()
                     except OSError:

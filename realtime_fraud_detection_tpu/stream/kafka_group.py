@@ -108,12 +108,25 @@ class GroupMembership:
 
     def __init__(self, broker: KafkaBroker, group_id: str, topics: List[str],
                  session_timeout_ms: int = 10_000,
-                 rebalance_timeout_ms: int = 10_000):
+                 rebalance_timeout_ms: int = 10_000,
+                 rejoin_sleep=None):
+        from realtime_fraud_detection_tpu.utils.backoff import (
+            DeterministicBackoff,
+            instance_seed,
+        )
+
         self.broker = broker
         self.group_id = group_id
         self.topics = list(topics)
         self.session_timeout_ms = session_timeout_ms
         self.rebalance_timeout_ms = rebalance_timeout_ms
+        # rejoin-retry schedule: bounded exponential + deterministic jitter
+        # seeded PER MEMBER INSTANCE (a group's members are exactly the
+        # herd that must stagger its rejoin storm — a group-keyed seed
+        # would synchronize them); ``rejoin_sleep`` is the injected seam
+        self._backoff = DeterministicBackoff(
+            base_s=0.05, mult=2.0, max_s=0.4,
+            seed=instance_seed(group_id), sleep=rejoin_sleep)
         self.member_id = ""
         self.generation = -1
         self.is_leader = False
@@ -133,6 +146,7 @@ class GroupMembership:
             # rtfd-lint: allow[wall-clock] group-membership heartbeats/deadlines are real time
             deadline = (time.monotonic()
                         + self.rebalance_timeout_ms / 1000.0 * 2)
+            attempt = 0
             while True:
                 try:
                     self._join_sync()
@@ -145,8 +159,13 @@ class GroupMembership:
                         raise
                     if e.code == ERR_UNKNOWN_MEMBER_ID:
                         self.member_id = ""
-                    # rtfd-lint: allow[lock-order] deliberate: rejoin backoff holds the membership lock (no concurrent join/heartbeat allowed)
-                    time.sleep(0.05)
+                    # The membership lock deliberately spans this retry
+                    # wait (no concurrent join/heartbeat allowed); the
+                    # wait goes through the injected backoff seam —
+                    # bounded exponential + deterministic jitter instead
+                    # of a fixed bare sleep.
+                    self._backoff.sleep(attempt)
+                    attempt += 1
 
     def _join_sync(self) -> None:
         join_body = (
@@ -266,12 +285,14 @@ class KafkaGroupConsumer:
 
     def __init__(self, broker: KafkaBroker, topics: List[str], group_id: str,
                  session_timeout_ms: int = 10_000,
-                 heartbeat_interval_s: float = 1.0):
+                 heartbeat_interval_s: float = 1.0,
+                 rejoin_sleep=None):
         self.broker = broker
         self.topics = list(topics)
         self.group_id = group_id
         self.membership = GroupMembership(
-            broker, group_id, topics, session_timeout_ms=session_timeout_ms)
+            broker, group_id, topics, session_timeout_ms=session_timeout_ms,
+            rejoin_sleep=rejoin_sleep)
         self.heartbeat_interval_s = heartbeat_interval_s
         self._last_heartbeat = 0.0
         self._position: Dict[tuple, int] = {}

@@ -691,8 +691,11 @@ class BrokerServer:
                 req.get("key"), req["value"], req.get("timestamp"))])[0]
             return {"partition": rec.partition, "offset": rec.offset}
         if op == "produce_batch":
+            # optional per-record "ts": drills stamp virtual arrival times
+            # so consumer-side budget/latency math shares one time base
             recs = self._produce(req["topic"], [
-                (item.get("k"), item["v"], None) for item in req["records"]])
+                (item.get("k"), item["v"], item.get("ts"))
+                for item in req["records"]])
             return {"n": len(recs)}
         if op == "fetch":
             # reads stop at the high watermark: a record above it exists on
@@ -764,14 +767,48 @@ class NetBrokerClient:
     Implements the five methods ``transport.Consumer`` needs (committed /
     partitions / read / commit / lag) plus the producer surface, so every
     component that takes an ``InMemoryBroker`` takes one of these.
+
+    Reconnect semantics (broker RESTART survival): on a dead connection
+    the client retries up to ``reconnect_attempts`` times with bounded
+    exponential backoff + deterministic jitter, reconnecting to the same
+    address — a broker that restarts from its WAL resumes serving the
+    same log. A retried *produce* across the gap may duplicate (the ack
+    may have been lost in flight — standard at-least-once; consumers
+    dedupe by transaction id). Every reconnect bumps the client's
+    ``reconnect_epoch``: each ``transport.Consumer`` sharing this client
+    observes the change independently and re-fetches from the last
+    COMMITTED offset instead of its in-memory cursor — records
+    polled-but-uncommitted at the moment of the outage are re-delivered
+    rather than silently skipped past by a later commit (the
+    crash-recovery contract; pinned in tests/test_netbroker.py).
     """
 
     def __init__(self, host: str = "127.0.0.1", port: int = 9092,
-                 timeout_s: float = 30.0):
+                 timeout_s: float = 30.0, reconnect_attempts: int = 5,
+                 retry_sleep=None):
+        from realtime_fraud_detection_tpu.utils.backoff import (
+            DeterministicBackoff,
+            instance_seed,
+        )
+
+        self._addr = (host, int(port))
+        self._timeout_s = timeout_s
         self._sock = socket.create_connection((host, port), timeout=timeout_s)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._lock = threading.Lock()
         self._part_cache: Dict[str, int] = {}
+        self._reconnect_attempts = max(0, int(reconnect_attempts))
+        # monotonically increasing reconnect epoch: EVERY consumer sharing
+        # this client compares its last-seen epoch and rewinds to committed
+        # offsets when it observes a newer one (a read-and-clear flag would
+        # rewind only the first consumer to poll — the others would keep a
+        # stale cursor past re-delivered records)
+        self._reconnect_epoch = 0
+        # per-instance seed: all clients of one broker port are exactly
+        # the herd whose reconnect storms must de-correlate
+        self._backoff = DeterministicBackoff(
+            base_s=0.05, mult=2.0, max_s=0.8,
+            seed=instance_seed(str(port)), sleep=retry_sleep)
 
     def close(self) -> None:
         try:
@@ -779,12 +816,50 @@ class NetBrokerClient:
         except OSError:
             pass
 
-    def _call(self, req: Dict[str, Any]) -> Dict[str, Any]:
+    def _reconnect_locked(self) -> None:
+        """Drop the dead socket and dial the same address. Caller holds
+        ``_lock``. Raises OSError while the broker is still down."""
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._sock = socket.create_connection(self._addr,
+                                              timeout=self._timeout_s)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._reconnect_epoch += 1
+
+    def reconnect_epoch(self) -> int:
+        """Monotonic count of reconnects this client has survived.
+        ``transport.Consumer`` compares against its own last-seen value
+        and rewinds to committed offsets on any change — epoch-based so
+        EVERY consumer sharing this client observes every reconnect (a
+        read-and-clear flag would rewind only the first to poll)."""
         with self._lock:
-            _send_frame(self._sock, req)
-            resp = _recv_frame(self._sock)
+            return self._reconnect_epoch
+
+    def _call(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        resp = None
+        last: Optional[Exception] = None
+        for attempt in range(self._reconnect_attempts + 1):
+            try:
+                with self._lock:
+                    _send_frame(self._sock, req)
+                    resp = _recv_frame(self._sock)
+                if resp is None:
+                    raise ConnectionError("broker closed the connection")
+                break
+            except (ConnectionError, OSError) as e:
+                last = e
+                if attempt >= self._reconnect_attempts:
+                    raise
+                self._backoff.sleep(attempt)
+                try:
+                    with self._lock:
+                        self._reconnect_locked()
+                except OSError as e2:
+                    last = e2          # still down: next attempt backs off
         if resp is None:
-            raise ConnectionError("broker closed the connection")
+            raise ConnectionError(f"broker unreachable: {last}")
         if "error" in resp:
             raise RuntimeError(f"broker error: {resp['error']}")
         return resp
@@ -808,6 +883,16 @@ class NetBrokerClient:
         """(key, value) pairs in ONE frame — the fan-out hot path
         (one TCP round trip instead of one per record)."""
         records = [{"v": v, "k": k} for k, v in items]
+        if not records:
+            return 0
+        return self._call({"op": "produce_batch", "topic": topic,
+                           "records": records})["n"]
+
+    def produce_batch_stamped(self, topic: str, items) -> int:
+        """(key, value, timestamp) triples in ONE frame — the drill/replay
+        producer path: explicit record timestamps (virtual-clock arrivals)
+        at produce_batch_keyed's wire efficiency."""
+        records = [{"v": v, "k": k, "ts": ts} for k, v, ts in items]
         if not records:
             return 0
         return self._call({"op": "produce_batch", "topic": topic,
@@ -891,7 +976,10 @@ class HaBrokerClient(NetBrokerClient):
         last: Optional[Exception] = None
         for i, (host, port) in enumerate(self._addrs):
             try:
-                super().__init__(host=host, port=port, timeout_s=timeout_s)
+                # failover is THIS class's rotation, not same-address
+                # reconnection — the base client's reconnect loop stays off
+                super().__init__(host=host, port=port, timeout_s=timeout_s,
+                                 reconnect_attempts=0)
                 self._which = i
                 return
             except OSError as e:
@@ -913,7 +1001,7 @@ class HaBrokerClient(NetBrokerClient):
 
     def _call(self, req: Dict[str, Any]) -> Dict[str, Any]:
         last: Optional[Exception] = None
-        for _ in range(2 * len(self._addrs)):
+        for attempt in range(2 * len(self._addrs)):
             try:
                 return super()._call(req)
             except RuntimeError as e:
@@ -924,8 +1012,12 @@ class HaBrokerClient(NetBrokerClient):
                 last = e
             try:
                 self._rotate()
+                # a successful rotation is a reconnect: sharing consumers
+                # must rewind to committed offsets (transport.Consumer)
+                with self._lock:
+                    self._reconnect_epoch += 1
             except OSError as e:
                 last = e
-                time.sleep(0.05)
+                self._backoff.sleep(attempt)
         raise ConnectionError(
             f"no broker in {self._addrs} reachable and writable: {last}")

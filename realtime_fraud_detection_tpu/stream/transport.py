@@ -157,6 +157,17 @@ class InMemoryBroker:
             n += 1
         return n
 
+    def produce_batch_stamped(self, topic: str,
+                              items: Iterable[tuple]) -> int:
+        """(key, value, timestamp) triples — contract parity with
+        ``NetBrokerClient.produce_batch_stamped`` so drill producers run
+        unchanged against either transport."""
+        n = 0
+        for k, v, ts in items:
+            self.produce(topic, v, k, timestamp=ts)
+            n += 1
+        return n
+
     def produce_batch_keyed(self, topic: str,
                             items: Iterable[tuple]) -> int:
         """Batch produce of explicit (key, value) pairs — for payloads that
@@ -218,6 +229,11 @@ class Consumer:
         self.group_id = group_id
         self.faults = faults
         self._position: Dict[tuple, int] = {}
+        # networked brokers expose a monotonic reconnect epoch; each
+        # consumer tracks its OWN last-seen value, so every consumer
+        # sharing one client observes every reconnect (see poll)
+        self._epoch_fn = getattr(broker, "reconnect_epoch", None)
+        self._seen_epoch = self._epoch_fn() if self._epoch_fn else 0
         self.seek_to_committed()
 
     def seek_to_committed(self) -> None:
@@ -228,6 +244,19 @@ class Consumer:
         }
 
     def poll(self, max_records: int = 256) -> List[Record]:
+        # Networked brokers bump a reconnect epoch after a connection loss
+        # (possibly a broker RESTART): the in-memory cursor may sit past
+        # records that were polled but never committed when the connection
+        # died — continuing from it would let the NEXT commit advance past
+        # them (silent loss). Rewind to the committed offsets instead;
+        # re-delivered records dedupe downstream (scorer txn-cache).
+        # Epoch-compared per consumer: a shared client's OTHER consumers
+        # each still see the reconnect on their own next poll.
+        if self._epoch_fn is not None:
+            epoch = self._epoch_fn()
+            if epoch != self._seen_epoch:
+                self._seen_epoch = epoch
+                self.seek_to_committed()
         out: List[Record] = []
         for (t, p), pos in self._position.items():
             if len(out) >= max_records:

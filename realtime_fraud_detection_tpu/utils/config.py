@@ -474,6 +474,60 @@ class FeedbackSettings:
 
 
 @dataclass
+class ChaosSettings:
+    """Chaos plane knobs (chaos/): deterministic fault injection + the
+    adversarial fraud-ring scenario, composed by ``rtfd chaos-drill``.
+
+    Disabled by default — the plane exists for drills/tests/staging soaks,
+    never wired into a hot path (injectors are explicit objects a harness
+    constructs; production code paths carry no chaos branches). The knobs
+    reach the drill via ``rtfd chaos-drill --config file.json``
+    (``chaos.drill.apply_chaos_settings`` overlays them onto the drill
+    config); all are virtual-clock quantities, so changing them reshapes
+    the replayed timeline deterministically. ``enabled`` gates nothing
+    today — it is the config-file switch a future always-on staging soak
+    consults; the drill runs whenever invoked.
+    """
+
+    enabled: bool = False
+    seed: int = 11
+    # fault windows (virtual seconds, relative to their phase starts)
+    broker_outage_s: float = 1.5       # replica down -> NotEnoughReplicas
+    label_stall_s: float = 4.0         # label stream held back
+    flash_crowd_mult: float = 2.5      # peak offered load / capacity
+    flash_burst_mult: float = 1.6      # short bursts on top of the peak
+    # adversarial fraud ring (sim/fraud_patterns.FraudRingConfig)
+    ring_rate: float = 0.10
+    ring_members: int = 24
+    ring_merchants: int = 6
+    ring_devices: int = 4
+    ring_ips: int = 3
+    # device-pool faults: how many in-flight fetches the dead replica
+    # fails before revival, and the slow-device injected delay
+    replica_faults: int = 1
+    slow_device_ms: float = 40.0
+
+    def validate(self) -> None:
+        if self.broker_outage_s <= 0 or self.label_stall_s < 0:
+            raise ValueError(
+                "chaos.broker_outage_s must be > 0 and label_stall_s >= 0")
+        if self.flash_crowd_mult < 1.0 or self.flash_burst_mult < 1.0:
+            raise ValueError(
+                f"chaos flash-crowd multipliers must be >= 1, got "
+                f"crowd={self.flash_crowd_mult} "
+                f"burst={self.flash_burst_mult}")
+        if not 0.0 < self.ring_rate <= 1.0:
+            raise ValueError(
+                f"chaos.ring_rate must be in (0, 1], got {self.ring_rate}")
+        if min(self.ring_members, self.ring_merchants, self.ring_devices,
+               self.ring_ips) < 1:
+            raise ValueError("chaos ring needs >= 1 of each entity kind")
+        if self.replica_faults < 1 or self.slow_device_ms < 0:
+            raise ValueError(
+                "chaos.replica_faults must be >= 1 and slow_device_ms >= 0")
+
+
+@dataclass
 class StateConfig:
     """Windowed state store settings (RedisService.java key TTLs)."""
 
@@ -581,6 +635,7 @@ class Config:
     feedback: FeedbackSettings = field(default_factory=FeedbackSettings)
     tracing: TracingSettings = field(default_factory=TracingSettings)
     tuning: TuningSettings = field(default_factory=TuningSettings)
+    chaos: ChaosSettings = field(default_factory=ChaosSettings)
 
     def __post_init__(self) -> None:
         self._apply_env()
@@ -758,6 +813,7 @@ class Config:
         self.feedback.validate()
         self.tracing.validate()
         self.tuning.validate(qos=self.qos)
+        self.chaos.validate()
 
 
 def _merge_dataclass(obj: Any, data: Dict[str, Any]) -> None:

@@ -14,7 +14,7 @@ Three layers:
    pointed message.
 3. **Lockwatch** — unit pins (a deliberately inverted two-lock order must
    be detected as a cycle; a device wait under a held lock must be a
-   violation) and the real thing: all five deterministic drills run clean
+   violation) and the real thing: all six deterministic drills run clean
    under the instrumented locks.
 """
 
@@ -422,7 +422,7 @@ class TestLockwatchUnderDrills:
         assert lw["acquisitions"] > 0 and lw["locks"]
 
     @pytest.mark.slow
-    def test_lockwatch_cli_all_five_drills(self):
+    def test_lockwatch_cli_all_six_drills(self):
         proc = subprocess.run(
             [sys.executable, "-m", "realtime_fraud_detection_tpu",
              "lint", "--lockwatch", "--fast"],

@@ -1,0 +1,407 @@
+"""Chaos plane: fault scheduling (chaos/faults.py), the deterministic
+backoff seam (utils/backoff.py), the coordinated fraud ring
+(sim/fraud_patterns.FraudRing), the chaos_* metrics mirror, config
+validation, and the `rtfd chaos-drill --fast` tier-1 smoke."""
+
+import json
+
+import numpy as np
+import pytest
+
+from realtime_fraud_detection_tpu.chaos import (
+    ChaosPlan,
+    ConsumerMemberKill,
+    DeviceReplicaDeath,
+    FaultWindow,
+    LabelStall,
+    SlowDevice,
+)
+from realtime_fraud_detection_tpu.utils.backoff import DeterministicBackoff
+
+
+# ---------------------------------------------------------------------------
+# fault windows + plan scheduling
+# ---------------------------------------------------------------------------
+
+class TestFaultWindow:
+    def test_validate_rejects_empty_names_and_bad_interval(self):
+        with pytest.raises(ValueError, match="name and a kind"):
+            FaultWindow("", "broker", 0.0, 1.0).validate()
+        with pytest.raises(ValueError, match="t_end > t_start"):
+            FaultWindow("w", "broker", 2.0, 2.0).validate()
+
+    def test_plan_rejects_duplicate_names(self):
+        with pytest.raises(ValueError, match="duplicate"):
+            ChaosPlan([FaultWindow("a", "k", 0.0, 1.0),
+                       FaultWindow("a", "k", 2.0, 3.0)])
+
+    def test_bind_unknown_window_raises(self):
+        plan = ChaosPlan([FaultWindow("a", "k", 0.0, 1.0)])
+        with pytest.raises(ValueError, match="no fault window"):
+            plan.bind("nope", LabelStall())
+
+
+class _RecInjector:
+    def __init__(self):
+        self.calls = []
+
+    def begin(self, now):
+        self.calls.append(("begin", now))
+
+    def end(self, now):
+        self.calls.append(("end", now))
+
+
+class TestChaosPlan:
+    def test_transitions_fire_once_in_order(self):
+        plan = ChaosPlan([FaultWindow("a", "k", 1.0, 2.0),
+                          FaultWindow("b", "k", 1.5, 3.0)])
+        inj = _RecInjector()
+        plan.bind("a", inj)
+        assert plan.poll(0.5) == []
+        trans = plan.poll(1.6)
+        assert [(e, w.name) for e, w in trans] == [("begin", "a"),
+                                                  ("begin", "b")]
+        assert inj.calls == [("begin", 1.6)]
+        # re-polling the same instant fires nothing twice
+        assert plan.poll(1.6) == []
+        trans = plan.poll(2.5)
+        assert [(e, w.name) for e, w in trans] == [("end", "a")]
+        assert inj.calls[-1] == ("end", 2.5)
+        assert plan.active(2.5) == ["b"]
+        assert plan.is_active("b", 2.5) and not plan.is_active("a", 2.5)
+
+    def test_fully_past_window_fires_begin_then_end(self):
+        """A clock leap over a whole window must still run the injector's
+        cleanup — begin and end both fire, in order."""
+        plan = ChaosPlan([FaultWindow("a", "k", 1.0, 2.0)])
+        inj = _RecInjector()
+        plan.bind("a", inj)
+        trans = plan.poll(10.0)
+        assert [(e, w.name) for e, w in trans] == [("begin", "a"),
+                                                  ("end", "a")]
+        assert [c[0] for c in inj.calls] == ["begin", "end"]
+
+    def test_note_recovered_first_observation_wins(self):
+        plan = ChaosPlan([FaultWindow("a", "k", 1.0, 2.0)])
+        plan.poll(5.0)
+        plan.note_recovered("a", 3.5)
+        plan.note_recovered("a", 9.0)          # idempotent: first wins
+        plan.note_recovered("missing", 9.0)    # unknown window: no-op
+        assert plan.recovery_s == {"a": 1.5}
+        snap = plan.snapshot(5.0)
+        assert snap["recovery_s"] == {"a": 1.5}
+        w = snap["windows"][0]
+        assert w["begun"] and w["ended"] and not w["active"]
+        assert [e["event"] for e in snap["events"]] == ["begin", "end"]
+
+
+class _StubPool:
+    def __init__(self):
+        self.calls = []
+
+    def inject_fault(self, idx, n):
+        self.calls.append(("fault", idx, n))
+
+    def inject_slow(self, idx, delay_s, n):
+        self.calls.append(("slow", idx, delay_s, n))
+
+    def revive(self, idx):
+        self.calls.append(("revive", idx))
+
+
+class TestInjectors:
+    def test_device_replica_death_arms_and_revives(self):
+        pool = _StubPool()
+        inj = DeviceReplicaDeath(pool, 2, n_faults=3)
+        inj.begin(1.0)
+        inj.end(2.0)
+        assert pool.calls == [("fault", 2, 3), ("revive", 2)]
+
+    def test_slow_device_is_one_shot(self):
+        pool = _StubPool()
+        inj = SlowDevice(pool, 1, 0.04, n=2)
+        inj.begin(1.0)
+        inj.end(2.0)                            # no revive: never unhealthy
+        assert pool.calls == [("slow", 1, 0.04, 2)]
+
+    def test_label_stall_gates(self):
+        stall = LabelStall()
+        assert not stall.active
+        stall.begin(1.0)
+        assert stall.active and stall.stalls == 1
+        stall.end(2.0)
+        assert not stall.active
+
+    def test_consumer_member_kill_fires_once(self):
+        class _Srv:
+            def __init__(self):
+                self.killed = []
+
+            def kill_member(self, gid, mid):
+                self.killed.append((gid, mid))
+
+        srv = _Srv()
+        inj = ConsumerMemberKill(srv, "g", "m-1")
+        inj.begin(1.0)
+        inj.end(2.0)                            # no resurrection
+        assert srv.killed == [("g", "m-1")] and inj.killed == 1
+
+
+# ---------------------------------------------------------------------------
+# deterministic backoff (the satellite replacing the fixed sleeps)
+# ---------------------------------------------------------------------------
+
+class TestDeterministicBackoff:
+    def test_validation(self):
+        with pytest.raises(ValueError, match="base_s > 0"):
+            DeterministicBackoff(base_s=0.0)
+        with pytest.raises(ValueError, match="base_s > 0"):
+            DeterministicBackoff(base_s=0.2, max_s=0.1)
+        with pytest.raises(ValueError, match="jitter_frac"):
+            DeterministicBackoff(jitter_frac=1.5)
+
+    def test_delay_is_pure_bounded_exponential(self):
+        b1 = DeterministicBackoff(base_s=0.05, mult=2.0, max_s=0.4, seed=9)
+        b2 = DeterministicBackoff(base_s=0.05, mult=2.0, max_s=0.4, seed=9)
+        sched = [b1.delay(k) for k in range(8)]
+        # pure: a fresh instance with the same seed replays it exactly
+        assert sched == [b2.delay(k) for k in range(8)]
+        # bounded: never exceeds max_s; jitter only ever SHRINKS the raw
+        # exponential, so the schedule stays within (0, max_s]
+        assert all(0.0 < d <= 0.4 for d in sched)
+        raw = [min(0.4, 0.05 * 2.0 ** k) for k in range(8)]
+        assert all(d <= r for d, r in zip(sched, raw))
+
+    def test_seeds_decorrelate_schedules(self):
+        a = DeterministicBackoff(seed=1)
+        b = DeterministicBackoff(seed=2)
+        assert [a.delay(k) for k in range(4)] != [b.delay(k)
+                                                 for k in range(4)]
+
+    def test_no_jitter_is_exact_exponential(self):
+        b = DeterministicBackoff(base_s=0.1, mult=2.0, max_s=0.5,
+                                 jitter_frac=0.0)
+        assert [b.delay(k) for k in range(4)] == [0.1, 0.2, 0.4, 0.5]
+
+    def test_sleep_seam_records_and_applies(self):
+        applied = []
+        b = DeterministicBackoff(base_s=0.05, max_s=0.2, seed=3,
+                                 sleep=applied.append)
+        d0 = b.sleep(0)
+        d1 = b.sleep(1)
+        assert applied == [d0, d1] == list(b.slept)
+        assert d0 == b.delay(0) and d1 == b.delay(1)
+        # the ledger is bounded (these live in long-lived transports)
+        assert b.slept.maxlen is not None
+
+
+# ---------------------------------------------------------------------------
+# coordinated fraud ring
+# ---------------------------------------------------------------------------
+
+class TestFraudRing:
+    def test_config_validation(self):
+        from realtime_fraud_detection_tpu.sim.fraud_patterns import (
+            FraudRingConfig,
+        )
+
+        with pytest.raises(ValueError, match="rate"):
+            FraudRingConfig(rate=1.5).validate()
+        with pytest.raises(ValueError, match=">= 1"):
+            FraudRingConfig(n_devices=0).validate()
+
+    def test_ring_is_deterministic_and_shares_entities(self):
+        from realtime_fraud_detection_tpu.sim.fraud_patterns import (
+            FraudRingConfig,
+        )
+        from realtime_fraud_detection_tpu.sim.simulator import (
+            TransactionGenerator,
+        )
+
+        cfg = FraudRingConfig(n_members=8, n_merchants=3, n_devices=2,
+                              n_ips=2, rate=1.0)
+        outs = []
+        for _ in range(2):
+            gen = TransactionGenerator(num_users=200, num_merchants=50,
+                                       seed=17)
+            ring = gen.inject_fraud_ring(cfg)
+            txns = gen.generate_batch(64)
+            outs.append((list(ring.member_ids), ring.device_ids, ring.ips,
+                         [t["transaction_id"] for t in txns],
+                         [t.get("device_id") for t in txns]))
+            # rate=1.0: every transaction is ring traffic through the
+            # SHARED entity sets — the structure the graph branch consumes
+            assert ring.applied == 64
+            assert {t["user_id"] for t in txns} <= {str(u)
+                                                    for u in ring.member_ids}
+            assert {t["device_id"] for t in txns} <= set(ring.device_ids)
+            assert {t["ip_address"] for t in txns} <= set(ring.ips)
+            assert {t["merchant_id"] for t in txns} \
+                <= {str(m) for m in ring.merchant_ids}
+            assert all(t["is_fraud"] and t["fraud_type"] == "fraud_ring"
+                       for t in txns)
+            # camouflage: the incumbent's leaky prior stays benign
+            assert all(t["fraud_score"] < 0.3 for t in txns)
+        # identical seed => identical membership AND identical traffic
+        assert outs[0] == outs[1]
+
+    def test_clear_ring_stops_application(self):
+        from realtime_fraud_detection_tpu.sim.fraud_patterns import (
+            FraudRingConfig,
+        )
+        from realtime_fraud_detection_tpu.sim.simulator import (
+            TransactionGenerator,
+        )
+
+        gen = TransactionGenerator(num_users=100, num_merchants=30, seed=5)
+        ring = gen.inject_fraud_ring(FraudRingConfig(rate=1.0))
+        gen.generate_batch(8)
+        assert ring.applied == 8
+        gen.clear_fraud_ring()
+        gen.generate_batch(8)
+        assert ring.applied == 8
+
+
+# ---------------------------------------------------------------------------
+# config + metrics mirror
+# ---------------------------------------------------------------------------
+
+class TestChaosSettings:
+    def test_validation(self):
+        from realtime_fraud_detection_tpu.utils.config import ChaosSettings
+
+        ChaosSettings().validate()
+        with pytest.raises(ValueError, match="broker_outage_s"):
+            ChaosSettings(broker_outage_s=0.0).validate()
+        with pytest.raises(ValueError, match="multipliers"):
+            ChaosSettings(flash_crowd_mult=0.5).validate()
+        with pytest.raises(ValueError, match="ring_rate"):
+            ChaosSettings(ring_rate=0.0).validate()
+        with pytest.raises(ValueError, match="entity kind"):
+            ChaosSettings(ring_devices=0).validate()
+        with pytest.raises(ValueError, match="replica_faults"):
+            ChaosSettings(replica_faults=0).validate()
+
+    def test_config_carries_chaos_block(self):
+        from realtime_fraud_detection_tpu.utils.config import Config
+
+        cfg = Config()
+        assert cfg.chaos.enabled is False
+        cfg.validate()
+
+    def test_settings_overlay_reshapes_drill_config(self, tmp_path):
+        """chaos.* is LIVE config: the overlay maps every timeline knob
+        onto the drill config, and the CLI path loads it via --config."""
+        import json
+
+        from realtime_fraud_detection_tpu.chaos.drill import (
+            ChaosDrillConfig,
+            apply_chaos_settings,
+        )
+        from realtime_fraud_detection_tpu.utils.config import (
+            ChaosSettings,
+            Config,
+        )
+
+        s = ChaosSettings(seed=99, broker_outage_s=2.5, label_stall_s=1.0,
+                          flash_crowd_mult=3.0, flash_burst_mult=1.2,
+                          ring_rate=0.2, ring_members=10, ring_merchants=2,
+                          ring_devices=3, ring_ips=5, replica_faults=2,
+                          slow_device_ms=15.0)
+        cfg = apply_chaos_settings(ChaosDrillConfig.fast(), s)
+        assert (cfg.seed, cfg.outage_s, cfg.label_stall_s) == (99, 2.5, 1.0)
+        assert (cfg.flash_mult, cfg.flash_burst_mult) == (3.0, 1.2)
+        assert (cfg.ring_rate, cfg.ring_members, cfg.ring_merchants,
+                cfg.ring_devices, cfg.ring_ips) == (0.2, 10, 2, 3, 5)
+        assert (cfg.replica_faults, cfg.slow_device_ms) == (2, 15.0)
+        # fast-config fields not owned by ChaosSettings are untouched
+        assert cfg.n_devices == ChaosDrillConfig.fast().n_devices
+        # the file path the CLI uses round-trips
+        p = tmp_path / "c.json"
+        p.write_text(json.dumps({"chaos": {"seed": 99, "ring_rate": 0.2}}))
+        loaded = Config.from_file(str(p)).chaos
+        assert loaded.seed == 99 and loaded.ring_rate == 0.2
+
+
+class TestSyncChaos:
+    def test_counter_delta_mirror(self):
+        from realtime_fraud_detection_tpu.obs.metrics import MetricsCollector
+
+        m = MetricsCollector()
+        plan = ChaosPlan([FaultWindow("broker_outage", "broker", 1.0, 2.0)])
+        plan.poll(1.5)
+        m.sync_chaos(plan.snapshot(1.5))
+        m.sync_chaos(plan.snapshot(1.5))        # re-sync: NOT double-counted
+        assert m.chaos_fault_windows.value(fault="broker_outage") == 1.0
+        assert m.chaos_fault_active.value(fault="broker_outage") == 1.0
+        plan.poll(2.5)
+        plan.note_recovered("broker_outage", 2.75)
+        m.sync_chaos(plan.snapshot(2.5))
+        assert m.chaos_fault_windows.value(fault="broker_outage") == 1.0
+        assert m.chaos_fault_active.value(fault="broker_outage") == 0.0
+        assert m.chaos_recovery_seconds.value(fault="broker_outage") == 0.75
+        # the series render on the standard exposition
+        text = m.registry.render()
+        assert "chaos_fault_windows_total" in text
+        assert "chaos_recovery_seconds" in text
+
+
+# ---------------------------------------------------------------------------
+# drill plumbing + tier-1 smoke
+# ---------------------------------------------------------------------------
+
+class TestCompactSummary:
+    def test_under_2kb_and_parseable(self):
+        from realtime_fraud_detection_tpu.chaos.drill import (
+            compact_chaos_summary,
+        )
+
+        summary = {"metric": "chaos_drill", "passed": True,
+                   "checks": {f"check_{i}": True for i in range(20)},
+                   "phase_auc": {"healthy": 0.95, "recovery": 0.97},
+                   "digest": "a" * 64}
+        compact = compact_chaos_summary(summary)
+        line = json.dumps(compact, separators=(",", ":"))
+        assert len(line.encode()) < 2048
+        assert compact["passed"] is True
+
+    def test_oversized_summary_still_fits(self):
+        from realtime_fraud_detection_tpu.chaos.drill import (
+            compact_chaos_summary,
+        )
+
+        summary = {"metric": "chaos_drill", "passed": False,
+                   "checks": {f"very_long_check_name_{i}" * 4: False
+                              for i in range(64)}}
+        compact = compact_chaos_summary(summary)
+        assert len(json.dumps(compact,
+                              separators=(",", ":")).encode()) < 2048
+
+
+def test_chaos_drill_fast_smoke(monkeypatch, capsys):
+    """Tier-1 acceptance: `rtfd chaos-drill --fast` runs un-slow-marked on
+    every pass — through the CLI entry (in-process child mode; the session
+    already provides the multi-device host platform). Pins the combined-
+    recovery contract: zero high-value sheds, effectively-once across the
+    broker outage, ladder + burn recovery, pool retry absorbed, ring AUC
+    retrained back, and a bit-identical second run."""
+    from realtime_fraud_detection_tpu import cli
+
+    monkeypatch.setenv("_RTFD_CHAOS_DRILL_CHILD", "1")
+    rc = cli.main(["chaos-drill", "--fast"])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    compact = json.loads(out[-1])               # final line: compact verdict
+    assert len(out[-1].encode()) < 2048
+    assert compact["passed"] is True
+    checks = compact["checks"]
+    assert checks["zero_high_value_sheds"]
+    assert checks["effectively_once"] and checks["offsets_gap_free"]
+    assert checks["ladder_recovered"] and checks["burn_recovered"]
+    assert checks["pool_retry_absorbed"] and checks["fifo_batch_integrity"]
+    assert checks["ring_promoted_via_gate"] and checks["ring_auc_recovered"]
+    assert checks["replay_bit_identical"]
+    full = json.loads(out[-2])                  # preceding line: full result
+    assert full["digest"] and full["high_value_sheds"] == 0
+    assert full["phase_auc"]["recovery"] >= full["phase_auc"]["healthy"] - 0.01

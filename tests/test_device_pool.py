@@ -243,6 +243,49 @@ class TestDevicePool:
         pool.replicas[0].healthy = False
         assert pool.total_slots() == (len(pool) - 1) * 2
 
+    def test_slow_replica_keeps_fifo(self, pooled):
+        """Chaos satellite: a DELAYED replica (inject_slow) is not a dead
+        one — no retry, no health change, and FIFO completion across the
+        pool holds while one replica lags."""
+        import time as _time
+
+        gen, scorer, pool = pooled
+        batches = [gen.generate_batch(BATCH) for _ in range(len(pool))]
+        pend = [scorer.dispatch(b, now=1000.0) for b in batches]
+        victim = pend[0].pool_token.replica_idx
+        pool.inject_slow(victim, 0.05, n=1)
+        t0 = _time.monotonic()
+        results = [scorer.finalize(p, now=1000.0) for p in pend]
+        elapsed = _time.monotonic() - t0
+        got = [r["transaction_id"] for batch in results for r in batch]
+        want = [str(r["transaction_id"]) for b in batches for r in b]
+        assert got == want                     # FIFO survived the lag
+        assert elapsed >= 0.05                 # the delay really applied
+        st = pool.stats()
+        assert st["retries"] == 0              # delayed != dead: no rescue
+        assert st["healthy"] == len(pool)
+        assert st["devices"][victim]["failures"] == 0
+        assert pool.replicas[victim].slow_next == 0   # one-shot consumed
+
+    def test_revive_clears_armed_faults(self, pooled):
+        """Chaos satellite: revive() means HEALTHY — a stale armed fault
+        or slow injection must not re-kill the replica after its fault
+        window closed."""
+        gen, scorer, pool = pooled
+        victim = 0
+        pool.inject_fault(victim, 3)
+        pool.inject_slow(victim, 5.0, n=4)     # would hang a later fetch
+        pool.revive(victim)
+        assert pool.replicas[victim].fail_next == 0
+        assert pool.replicas[victim].slow_next == 0
+        pend = [scorer.dispatch(gen.generate_batch(BATCH), now=1000.0)
+                for _ in range(len(pool))]     # round-robin hits victim
+        assert victim in {p.pool_token.replica_idx for p in pend}
+        for p in pend:
+            assert len(scorer.finalize(p, now=1000.0)) == BATCH
+        st = pool.stats()
+        assert st["retries"] == 0 and st["healthy"] == len(pool)
+
 
 # ------------------------------------------------- pooled stream job wiring
 class TestPooledStreamJob:

@@ -462,6 +462,98 @@ def test_group_kill_consumer_no_record_loss():
         server.stop()
 
 
+def test_group_rebalance_mid_stream_survivor_no_double_processing():
+    """Chaos satellite: `kill_member` MID-STREAM — records still arriving
+    while the coordinator expires one member's session. The group
+    rebalances onto the survivor (all partitions reassigned) and records
+    produced across the rebalance all arrive. The surviving member never
+    re-processes anything it COMMITTED (its committed positions survive
+    the generation change); a round whose commit is fenced by the
+    rebalance replays at-least-once — bounded, never a loop — and once
+    the group settles the survivor replays nothing at all."""
+    import time as _time
+
+    from realtime_fraud_detection_tpu.stream.kafka_group import (
+        KafkaGroupConsumer,
+    )
+
+    server = FakeKafkaServer(port=0).start()
+    b1, b2 = _group_broker(server), _group_broker(server)
+    prod = _group_broker(server)
+    try:
+        prod.produce_batch(T.TRANSACTIONS, [{"n": i} for i in range(120)],
+                           key_fn=lambda v: str(v["n"]))
+        c1 = KafkaGroupConsumer(b1, [T.TRANSACTIONS], "g-mid",
+                                session_timeout_ms=1000,
+                                heartbeat_interval_s=0.1)
+        made = {}
+        t = threading.Thread(target=lambda: made.update(c2=KafkaGroupConsumer(
+            b2, [T.TRANSACTIONS], "g-mid", session_timeout_ms=1000,
+            heartbeat_interval_s=0.1)))
+        t.start()
+        deadline = _time.monotonic() + 8.0
+        while "c2" not in made and _time.monotonic() < deadline:
+            c1.poll(0)
+            _time.sleep(0.05)
+        t.join(timeout=8.0)
+        c2 = made["c2"]
+
+        # both members consume mid-stream, committing every round; this
+        # pre-kill commit lands in a stable group, so it MUST stick
+        seen_c1, seen_c2 = [], []
+        pre_slots = set()               # (topic, partition, offset) at c2
+        for consumer, seen in ((c1, seen_c1), (c2, seen_c2)):
+            for r in consumer.poll(30):
+                seen.append(r.value["n"])
+                if consumer is c2:
+                    pre_slots.add((r.topic, r.partition, r.offset))
+            consumer.commit()
+
+        # the kill lands between commits, with more records still to come
+        server.kill_member("g-mid", c1.membership.member_id)
+        prod.produce_batch(T.TRANSACTIONS,
+                           [{"n": i} for i in range(120, 200)],
+                           key_fn=lambda v: str(v["n"]))
+
+        post_slots: list = []
+        deadline = _time.monotonic() + 10.0
+        while _time.monotonic() < deadline:
+            for r in c2.poll(100):
+                seen_c2.append(r.value["n"])
+                post_slots.append((r.topic, r.partition, r.offset))
+            c2.commit()
+            n_parts = b2.partitions(T.TRANSACTIONS)
+            owned = set(c2.assigned_partitions().get(T.TRANSACTIONS, []))
+            if owned == set(range(n_parts)) and c2.lag() == 0:
+                break
+            _time.sleep(0.05)
+
+        # partitions reassigned: the survivor owns every one
+        n_parts = b2.partitions(T.TRANSACTIONS)
+        assert set(c2.assigned_partitions()[T.TRANSACTIONS]) == \
+            set(range(n_parts))
+        assert c2.membership.rebalances >= 2
+        # nothing lost across the rebalance (c1's uncommitted reads are
+        # re-delivered to the survivor — at-least-once across MEMBERS)
+        assert set(seen_c1) | set(seen_c2) == set(range(200))
+        # the survivor NEVER re-processed a record it committed...
+        assert not pre_slots & set(post_slots)
+        # ...and a rebalance-fenced round replays at most once (bounded
+        # at-least-once, not a redelivery loop)
+        counts: dict = {}
+        for slot in post_slots:
+            counts[slot] = counts.get(slot, 0) + 1
+        assert max(counts.values()) <= 2
+        # settled group: everything committed, nothing replays
+        assert c2.poll(100) == []
+        c2.close()
+    finally:
+        b1.close()
+        b2.close()
+        prod.close()
+        server.stop()
+
+
 def test_group_zombie_commit_is_fenced():
     """A member evicted by the coordinator must NOT be able to advance
     offsets (ILLEGAL_GENERATION/UNKNOWN_MEMBER fencing) — the new owner's

@@ -112,6 +112,100 @@ def test_netbroker_durability(tmp_path):
         server2.stop()
 
 
+def test_consumer_resumes_from_committed_after_broker_restart(tmp_path):
+    """Chaos satellite regression: a NetBrokerClient that reconnects after
+    a broker RESTART must re-fetch from the last COMMITTED offset, not its
+    in-memory cursor — records polled-but-uncommitted at the moment of the
+    outage are re-delivered (and deduped downstream by txn id), never
+    silently skipped past by a later commit."""
+    log_dir = tmp_path / "wal"
+    server = BrokerServer(port=0, log_dir=str(log_dir)).start()
+    port = server.port
+    waits = []          # injected backoff seam: no wall sleeps in the test
+    client = NetBrokerClient(port=port, reconnect_attempts=8,
+                             retry_sleep=waits.append)
+    try:
+        client.produce_batch(T.TRANSACTIONS, [{"n": i} for i in range(30)],
+                             key_fn=lambda v: str(v["n"]))
+        c = client.consumer([T.TRANSACTIONS], "g")
+        first = c.poll(10)
+        c.commit()                       # committed: the recovery anchor
+        mid = c.poll(10)                 # polled but NOT committed
+        assert len(first) == len(mid) == 10
+
+        # broker dies and RESTARTS from its WAL on the same address
+        server.stop()
+        server = BrokerServer(port=port, log_dir=str(log_dir)).start()
+
+        # next poll rides the reconnect: the client rewinds to committed,
+        # so the uncommitted middle slice is DELIVERED AGAIN
+        rest = []
+        deadline = 50
+        while len(rest) < 20 and deadline > 0:
+            rest.extend(c.poll(100))
+            deadline -= 1
+        slots_mid = {(r.partition, r.offset) for r in mid}
+        slots_rest = {(r.partition, r.offset) for r in rest}
+        assert slots_mid <= slots_rest           # re-delivered, not skipped
+        assert waits                             # the backoff seam was hit
+        # nothing lost and nothing committed re-read: first∪rest covers all
+        slots_first = {(r.partition, r.offset) for r in first}
+        assert not slots_first & slots_rest
+        assert len(slots_first | slots_rest) == 30
+        vals = [r.value["n"] for r in first + rest]
+        assert set(vals) == set(range(30))
+        # committing now accounts for every offset — gap-free
+        c.commit()
+        ends = client.end_offsets(T.TRANSACTIONS)
+        assert [client.committed("g", T.TRANSACTIONS, p)
+                for p in range(len(ends))] == ends
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_every_sharing_consumer_rewinds_after_reconnect(tmp_path):
+    """Epoch regression pin: TWO consumers share ONE NetBrokerClient (the
+    StreamJob shape — transactions + labels consumers on the job's
+    client). After a broker restart, BOTH must rewind to committed — a
+    read-and-clear flag would rewind only the first to poll and leave the
+    second with a stale cursor over re-delivered records."""
+    log_dir = tmp_path / "wal"
+    server = BrokerServer(port=0, log_dir=str(log_dir)).start()
+    port = server.port
+    client = NetBrokerClient(port=port, reconnect_attempts=8,
+                             retry_sleep=lambda d: None)
+    try:
+        client.produce_batch(T.TRANSACTIONS, [{"n": i} for i in range(8)],
+                             key_fn=lambda v: str(v["n"]))
+        client.produce_batch(T.LABELS, [{"m": i} for i in range(8)],
+                             key_fn=lambda v: str(v["m"]))
+        c_txn = client.consumer([T.TRANSACTIONS], "g-txn")
+        c_lbl = client.consumer([T.LABELS], "g-lbl")
+        a = c_txn.poll(100)
+        b = c_lbl.poll(100)
+        assert len(a) == 8 and len(b) == 8     # polled, NOT committed
+
+        server.stop()
+        server = BrokerServer(port=port, log_dir=str(log_dir)).start()
+
+        # c_txn polls first and rides the reconnect; c_lbl polls SECOND —
+        # the epoch (not a consumed flag) must still rewind it
+        a2, b2 = [], []
+        for _ in range(10):
+            a2.extend(c_txn.poll(100))
+            b2.extend(c_lbl.poll(100))
+            if len(a2) >= 8 and len(b2) >= 8:
+                break
+        assert {(r.partition, r.offset) for r in a} \
+            == {(r.partition, r.offset) for r in a2}
+        assert {(r.partition, r.offset) for r in b} \
+            == {(r.partition, r.offset) for r in b2}
+    finally:
+        client.close()
+        server.stop()
+
+
 def test_stream_job_over_netbroker():
     """The full scoring job runs unchanged against the networked broker."""
     from realtime_fraud_detection_tpu.scoring import FraudScorer, ScorerConfig
