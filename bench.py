@@ -208,6 +208,19 @@ def _compact_summary(result: dict) -> dict:
             "high_value_sheds": ch.get("high_value_sheds"),
         } if (ch := result.get("chaos") or {})
             and not ch.get("error") else None),
+        "quantization": ({
+            "bytes_ratio": (qz.get("param_bytes") or {}).get("ratio"),
+            "bert_quant_us_per_txn": ((qz.get("branches") or {}).get(
+                "bert_text") or {}).get("quant_us_per_txn"),
+            "bert_speedup": ((qz.get("branches") or {}).get(
+                "bert_text") or {}).get("speedup"),
+            "trees_gemm_speedup": ((qz.get("branches") or {}).get(
+                "xgboost_primary") or {}).get("speedup"),
+            "max_divergence": max(
+                (v for v in (qz.get("divergence") or {}).values()
+                 if isinstance(v, (int, float))), default=None),
+        } if (qz := result.get("quantization") or {})
+            and not qz.get("error") else None),
         "quality": ({"auc": quality.get("auc"),
                      "accuracy": quality.get("accuracy")}
                     if quality else None),
@@ -237,7 +250,7 @@ def _compact_summary(result: dict) -> dict:
     while len(line.encode()) >= 2048:
         for victim in ("configs_txn_per_s", "operating_point", "quality",
                        "host_assembly", "pool_scaling", "autotune", "chaos",
-                       "latest_committed_tpu_capture",
+                       "quantization", "latest_committed_tpu_capture",
                        "text_encoder", "error"):
             if compact.pop(victim, None) is not None:
                 break
@@ -971,6 +984,22 @@ def run_bench() -> None:
         _log(f'chaos stage done: '
              f'{ {k: v for k, v in (result.get("chaos") or {}).items() if not isinstance(v, dict)} }')
 
+    # ------------------------------------------------- quantization stage
+    # Quantized scoring plane (models/quant.py): per-branch f32-vs-quant
+    # µs/txn, param bytes, divergence magnitudes. CPU only — the int8
+    # calibration pulls the f32 weights host-side once, which would flip
+    # a tunneled TPU into sync-dispatch mode in the pre-pull regime; the
+    # on-chip quantized numbers come from the --quant relay switches.
+    if not on_tpu and remaining() > 45:
+        try:
+            _quantization_stage(result, models, sc, bert_config,
+                                use_pallas, it, snapshot)
+        except Exception as e:  # noqa: BLE001
+            result["quantization"] = {
+                "error": f"{type(e).__name__}: {e}"[:200]}
+        _log(f'quantization stage done: '
+             f'{ {k: v for k, v in (result.get("quantization") or {}).items() if not isinstance(v, (dict, list))} }')
+
     # 3b. honest sequence lengths (VERDICT r3 missing-6): the reference
     # tokenizes at max_length 512 (bert_text_analyzer.py:201-202); seq 64
     # is the production truncation for short merchant/description strings.
@@ -1236,8 +1265,24 @@ def _pool_scaling_stage(result: dict, models, sc, bert_config,
     depth = 2
     base = make_example_batch(batch, sc, rng=np.random.default_rng(17))
     blobs, spec = pack_tree(base)
-    scorer = FraudScorer(models=models, scorer_config=sc,
-                         bert_config=bert_config)
+    # --quant (RTFD_BENCH_QUANT): measure the QUANTIZED pool — int8 BERT
+    # replicas + GEMM-form tree kernels, the rtfd quant-drill gated
+    # configuration — so one relay window captures f32 and quantized
+    # scaling side by side. Calibration pulls the f32 weights host-side
+    # once, HERE, before any timed dispatch.
+    quantized = os.environ.get("RTFD_BENCH_QUANT") == "1"
+    if quantized:
+        from realtime_fraud_detection_tpu.utils.config import (
+            Config,
+            QuantSettings,
+        )
+
+        scorer = FraudScorer(Config(quant=QuantSettings.full()),
+                             models=models, scorer_config=sc,
+                             bert_config=bert_config)
+    else:
+        scorer = FraudScorer(models=models, scorer_config=sc,
+                             bert_config=bert_config)
     scorer.sc.use_pallas = use_pallas
     f32 = blobs["f32"]
 
@@ -1277,6 +1322,7 @@ def _pool_scaling_stage(result: dict, models, sc, bert_config,
         "batch": batch,
         "inflight_depth": depth,
         "n_devices": len(devices),
+        "quantized": quantized,
         "single_device_txn_per_s": round(single_tp, 1),
     }
     if len(devices) == 1:
@@ -1593,6 +1639,117 @@ def _chaos_stage(result: dict, snapshot) -> None:
     snapshot("chaos")
 
 
+def _quantization_stage(result: dict, models, sc, bert_config,
+                        use_pallas: bool, it, snapshot) -> None:
+    """Quantized scoring plane (ISSUE 9 bench stage): per-branch µs/txn
+    f32-vs-quant, param bytes per branch, and host-side divergence stats.
+
+    Weight-only int8 BERT (models/quant.py) and the GEMM-form tree
+    kernels (models/trees.py) against their f32/gather baselines, each
+    timed with the shared varied-input/no-pull discipline. CPU only —
+    int8 calibration itself pulls the f32 weights device->host once
+    (host-side by contract), which would flip the tunneled TPU into
+    sync-dispatch mode in the pre-pull regime; the on-chip quantized
+    numbers come from the ``--quant`` switches on tune_tpu.py /
+    soak_tpu.py / this bench's pool_scaling stage in a dedicated relay
+    run. The pass/fail bar lives in ``rtfd quant-drill``; this stage
+    records the measured speed/bytes/divergence triple.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from realtime_fraud_detection_tpu.models.bert import bert_predict
+    from realtime_fraud_detection_tpu.models.isolation_forest import (
+        iforest_predict,
+    )
+    from realtime_fraud_detection_tpu.models.quant import (
+        bert_param_bytes,
+        quant_error_bound,
+        quantize_bert_params,
+    )
+    from realtime_fraud_detection_tpu.models.trees import (
+        tree_ensemble_predict,
+    )
+
+    batch, K = 256, 8
+    rng = np.random.default_rng(23)
+    # rtfd-lint: allow[d2h] host-side int8 calibration by contract (CPU-only stage, before any timed section)
+    host_bert = jax.device_get(models.bert)
+    qbert_host = quantize_bert_params(host_bert)
+    bytes_f32 = bert_param_bytes(models.bert)
+    bytes_int8 = bert_param_bytes(qbert_host)
+    qbert = jax.device_put(qbert_host)
+    entry: dict = {
+        "batch": batch,
+        "param_bytes": {
+            "bert_f32": bytes_f32,
+            "bert_int8": bytes_int8,
+            "ratio": round(bytes_f32 / max(bytes_int8, 1), 3),
+            "weight_reconstruction_bound": round(
+                quant_error_bound(qbert_host), 6),
+        },
+    }
+
+    toks = [jnp.asarray(rng.integers(0, bert_config.vocab_size,
+                                     (batch, sc.text_len)), jnp.int32)
+            for _ in range(K)]
+    tokm = jnp.ones((batch, sc.text_len), bool)
+    feats = [jnp.asarray(rng.standard_normal((batch, sc.feature_dim)),
+                         jnp.float32) for _ in range(K)]
+
+    bfn = jax.jit(lambda p, t, m: bert_predict(
+        p, t, m, bert_config, use_pallas=use_pallas))
+    branches: dict = {}
+    for name, fn_pair in (
+        ("bert_text", (
+            lambda i: bfn(models.bert, toks[i % K], tokm),
+            lambda i: bfn(qbert, toks[i % K], tokm))),
+        ("xgboost_primary", (
+            lambda i: tree_ensemble_predict(
+                models.trees, feats[i % K], kernel="gather"),
+            lambda i: tree_ensemble_predict(
+                models.trees, feats[i % K], kernel="gemm"))),
+        ("isolation_forest", (
+            lambda i: iforest_predict(
+                models.iforest, feats[i % K], kernel="gather"),
+            lambda i: iforest_predict(
+                models.iforest, feats[i % K], kernel="gemm"))),
+    ):
+        base_fn, quant_fn = fn_pair
+        iters = it(50 if name == "bert_text" else 200)
+        base_t = np.median(_time_blocked(base_fn, iters))
+        quant_t = np.median(_time_blocked(quant_fn, iters))
+        branches[name] = {
+            "f32_us_per_txn": round(base_t / batch * 1e6, 3),
+            "quant_us_per_txn": round(quant_t / batch * 1e6, 3),
+            "speedup": round(base_t / max(quant_t, 1e-12), 3),
+        }
+    entry["branches"] = branches
+
+    # host-side divergence stats over the same varied inputs (the gated
+    # bounds live in rtfd quant-drill; these are the observed magnitudes)
+    div_bert = max(
+        float(jnp.max(jnp.abs(bfn(models.bert, t, tokm)
+                              - bfn(qbert, t, tokm)))) for t in toks)
+    div_trees = max(
+        float(jnp.max(jnp.abs(
+            tree_ensemble_predict(models.trees, f, kernel="gather")
+            - tree_ensemble_predict(models.trees, f, kernel="gemm"))))
+        for f in feats)
+    div_if = max(
+        float(jnp.max(jnp.abs(
+            iforest_predict(models.iforest, f, kernel="gather")
+            - iforest_predict(models.iforest, f, kernel="gemm"))))
+        for f in feats)
+    entry["divergence"] = {
+        "bert_int8_max": div_bert,
+        "trees_gemm_max": div_trees,
+        "iforest_gemm_max": div_if,
+    }
+    result["quantization"] = entry
+    snapshot("quantization")
+
+
 def _e2e_soak(result: dict, models, sc, bert_config, use_pallas: bool,
               on_tpu: bool, remaining, snapshot) -> None:
     """The whole-framework StreamJob soak + measured detection quality."""
@@ -1759,10 +1916,16 @@ def _e2e_soak(result: dict, models, sc, bert_config, use_pallas: bool,
 
 def main() -> None:
     """Entry point for ``rtfd bench`` (cli.py cmd_bench)."""
+    if "--quant" in sys.argv:
+        # quantized pool_scaling (the rtfd quant-drill gated config);
+        # propagates to the inner process through the inherited env
+        os.environ["RTFD_BENCH_QUANT"] = "1"
     orchestrate()
 
 
 if __name__ == "__main__":
+    if "--quant" in sys.argv:
+        os.environ["RTFD_BENCH_QUANT"] = "1"
     if "--inner" in sys.argv:
         run_bench()
     else:

@@ -41,10 +41,12 @@ These rules encode exactly those contracts:
     inside a ``with``-lock body.
 
 ``determinism``
-    No global-RNG ``random.*`` / ``np.random.*`` draws in ``sim/`` or any
-    ``*drill*`` module — seeded generator instances
+    No global-RNG ``random.*`` / ``np.random.*`` draws in ``sim/``, any
+    ``*drill*`` module, or the quantization calibrators
+    (``DETERMINISM_MODULES``) — seeded generator instances
     (``np.random.default_rng(seed)``, ``random.Random(seed)``,
-    ``jax.random.PRNGKey``) only, so every drill replays bit-identically.
+    ``jax.random.PRNGKey``) only, so every drill replays bit-identically
+    and the same weights always calibrate to the same int8 blobs.
 
 ``pragma-hygiene``
     Every ``# rtfd-lint: allow[rule]`` must name a known rule and still
@@ -92,12 +94,28 @@ D2H_MODULES = frozenset({
     "scoring/device_pool.py",
     "scoring/host_pipeline.py",
     "scoring/pool_drill.py",
+    # quantized scoring plane (ISSUE 9): calibration is host-side work at
+    # model-swap time by contract — every np.asarray there must be a
+    # justified pragma, and anything unexplained is a dispatch-path leak.
+    # (scoring/quant_drill.py is deliberately NOT here: like the other
+    # drills it is an oracle-comparison harness whose whole job is
+    # pulling both programs' scores host-side; determinism scope still
+    # applies via the *drill* name convention.)
+    "models/quant.py",
 })
 # Function-scoped d2h contract: the scorer's dispatch half must stay
 # pull-free (finalize is the designated pull point).
 D2H_FUNCTIONS: Dict[str, frozenset] = {
     "scoring/scorer.py": frozenset({"dispatch", "dispatch_assembled"}),
 }
+
+# Modules under the determinism contract beyond the sim/ + *drill*
+# name conventions: int8 calibration must be a pure function of the
+# weights (hot-swap on N replicas and checkpoint round-trips both assume
+# the same f32 pytree always quantizes to the same blobs).
+DETERMINISM_MODULES = frozenset({
+    "models/quant.py",
+})
 
 # Param / degradation-mask mutators: reachable only under the score lock
 # (or from a single-writer thread, annotated at the entry point).
@@ -713,7 +731,8 @@ def _rule_determinism(ctx: "Context") -> List[Finding]:
     out: List[Finding] = []
     for mod in ctx.modules:
         base = os.path.basename(mod.relpath)
-        if not (mod.relpath.startswith("sim/") or "drill" in base):
+        if not (mod.relpath.startswith("sim/") or "drill" in base
+                or mod.relpath in DETERMINISM_MODULES):
             continue
         for node in ast.walk(mod.tree):
             if not isinstance(node, ast.Call):

@@ -82,13 +82,19 @@ def _derive_model_shapes(params: Any) -> Optional[Dict[str, Any]]:
         return None
     try:
         lstm_hidden = int(np.shape(params.lstm["b_gates"])[0]) // 4
+        # the word embedding is a bare f32 table, or the weight-only int8
+        # form {"qe": i8[rows, h], "scale": f32[rows]} (models/quant.py) —
+        # the hidden size lives in the table either way
+        word_emb = params.bert["word_emb"]
+        if isinstance(word_emb, dict):
+            word_emb = word_emb["qe"]
         return {
             "trees": [int(params.trees.n_trees), int(params.trees.depth)],
             "iforest": [
                 int(np.shape(params.iforest.feature)[0]),
                 int(np.shape(params.iforest.path_length)[1]).bit_length() - 1,
             ],
-            "bert_hidden": int(np.shape(params.bert["word_emb"])[1]),
+            "bert_hidden": int(np.shape(word_emb)[1]),
             "bert_layers": len(params.bert["layers"]),
             "feature_dim": int(np.shape(params.lstm["w_gates"])[0])
             - lstm_hidden,
@@ -96,6 +102,23 @@ def _derive_model_shapes(params: Any) -> Optional[Dict[str, Any]]:
         }
     except (KeyError, TypeError, IndexError, AttributeError):
         return None
+
+
+def _derive_quant_mode(params: Any) -> Optional[Dict[str, str]]:
+    """Auto-derive the quantization-mode stamp from a ScoringModels pytree.
+
+    Recorded on EVERY save that stores a ScoringModels (like model_shapes),
+    so restore can refuse silently crossing quantization modes: a
+    weight-only int8 checkpoint must never restore into an f32 scorer (or
+    vice versa) without an explicit ``allow_arch_mismatch``. Only the BERT
+    weight form is a PARAMETER property; the tree kernels are program
+    selections, not checkpoint state."""
+    if not hasattr(params, "bert"):
+        return None
+    from realtime_fraud_detection_tpu.models.quant import is_quantized_bert
+
+    return {"bert_weights": "int8" if is_quantized_bert(params.bert)
+            else "f32"}
 
 
 @dataclasses.dataclass
@@ -165,6 +188,9 @@ class CheckpointManager:
         shapes = meta.get("model_shapes")
         if params is not None and shapes is None:
             shapes = _derive_model_shapes(params)
+        quant_mode = meta.get("quant_mode")
+        if params is not None and quant_mode is None:
+            quant_mode = _derive_quant_mode(params)
         manifest = {
             "step": step,
             "wall_time": time.time(),
@@ -173,6 +199,7 @@ class CheckpointManager:
             "offsets": dict(offsets) if offsets is not None else None,
             "metadata": meta or None,
             "model_shapes": shapes,
+            "quant_mode": quant_mode,
         }
         with open(d / _MANIFEST, "w") as f:
             json.dump(manifest, f, indent=1)
@@ -221,6 +248,7 @@ class CheckpointManager:
         manifest = self.manifest(step)
         meta = manifest.get("metadata") or {}
         shapes = manifest.get("model_shapes") or meta.get("model_shapes") or {}
+        quant_mode = manifest.get("quant_mode") or {}
         want = {
             "bert_hidden": None if bert_config is None
             else bert_config.hidden_size,
@@ -251,16 +279,38 @@ class CheckpointManager:
                 path_length=jnp.zeros((n_if, 2 ** if_depth), jnp.float32),
                 c_psi=jnp.asarray(0.0, jnp.float32),
             ))
+        if quant_mode.get("bert_weights") == "int8":
+            # the SAVED pytree carries the weight-only int8 layout — orbax's
+            # typed restore needs a structurally matching template (whether
+            # the restoring scorer is allowed to SERVE it is
+            # restore_into_scorer's arch-stamp check, not a template concern)
+            from realtime_fraud_detection_tpu.models.quant import (
+                quantize_bert_params,
+            )
+
+            models = models.replace(bert=quantize_bert_params(models.bert))
         return models
 
     def restore_into_scorer(self, scorer, step: Optional[int] = None,
-                            lock=None) -> Checkpoint:
+                            lock=None,
+                            allow_arch_mismatch: bool = False) -> Checkpoint:
         """Restore params + host state into a FraudScorer (one recipe for
         both the CLI's ``serve --checkpoint-dir`` and the serving app's
         ``/reload-models``). The step is resolved ONCE so the template and
         the restore always read the same checkpoint even while a trainer
         writes new steps; ``lock`` (the serving score lock) makes the swap
-        atomic w.r.t. in-flight scoring."""
+        atomic w.r.t. in-flight scoring.
+
+        Quantization-mode arch stamp: a checkpoint whose recorded
+        ``quant_mode`` crosses the scorer's configured BERT weight form
+        (int8 checkpoint into an f32 scorer, or vice versa) is REFUSED
+        unless ``allow_arch_mismatch`` — the two forms score differently
+        (weight rounding), so a silent cross-mode restore would quietly
+        change served scores. With the override, the scorer serves the
+        checkpoint's actual form: an f32 restore into a quant scorer is
+        quantized by ``set_models``; an int8 restore into an f32 scorer
+        serves int8 (``quant_snapshot`` reads the live-params truth).
+        Old checkpoints without the stamp restore leniently."""
         import contextlib
 
         if step is None:
@@ -268,6 +318,18 @@ class CheckpointManager:
             if step is None:
                 raise FileNotFoundError(
                     f"no checkpoints under {self.directory}")
+        ck_mode = (self.manifest(step).get("quant_mode") or {}).get(
+            "bert_weights")
+        want_mode = getattr(getattr(scorer, "quant", None), "bert_mode",
+                            lambda: None)()
+        if (ck_mode is not None and want_mode is not None
+                and ck_mode != want_mode and not allow_arch_mismatch):
+            raise ValueError(
+                f"quantization-mode mismatch: checkpoint step {step} "
+                f"records bert_weights={ck_mode!r} but the scorer is "
+                f"configured for {want_mode!r}; restore with a matching "
+                f"quant config or pass allow_arch_mismatch to serve the "
+                f"checkpoint's form anyway")
         template = self.scoring_models_template(
             step=step, bert_config=scorer.bert_config,
             feature_dim=scorer.sc.feature_dim, node_dim=scorer.sc.node_dim)

@@ -144,7 +144,17 @@ def cmd_run_job(args: argparse.Namespace) -> int:
 
         shost, sport = _addr(args.state, 6379)
         state_client = RespClient(host=shost, port=sport)
-    scorer = FraudScorer(scorer_config=ScorerConfig(),
+    job_config_obj = None
+    if getattr(args, "quant", False):
+        # quantized scoring plane (models/quant.py): int8 BERT weights +
+        # GEMM-form tree kernels, the configuration rtfd quant-drill gates
+        from realtime_fraud_detection_tpu.utils.config import (
+            Config,
+            QuantSettings,
+        )
+
+        job_config_obj = Config(quant=QuantSettings.full())
+    scorer = FraudScorer(job_config_obj, scorer_config=ScorerConfig(),
                          state_client=state_client)
     scorer.seed_profiles(gen.users.profiles(), gen.merchants.profiles())
     feedback_plane = None
@@ -331,6 +341,10 @@ def cmd_serve(args: argparse.Namespace) -> int:
         config.qos.admission_rate = args.qos_rate
     if getattr(args, "trace", False):
         config.tracing.enabled = True
+    if getattr(args, "quant", False):
+        from realtime_fraud_detection_tpu.utils.config import QuantSettings
+
+        config.quant = QuantSettings.full()
     if getattr(args, "autotune", False):
         config.tuning.enabled = True
         # clamp the tuner's deadline search space to the budget's
@@ -401,8 +415,17 @@ def cmd_serve(args: argparse.Namespace) -> int:
                       f"pass --allow-arch-mismatch to combine anyway",
                       file=sys.stderr)
                 return 2
-        # rtfd-lint: allow[lock-order] CLI startup: restore runs before the serving loop starts
-        ck = mgr.restore_into_scorer(app.scorer)
+        try:
+            # rtfd-lint: allow[lock-order] CLI startup: restore runs before the serving loop starts
+            ck = mgr.restore_into_scorer(
+                app.scorer,
+                allow_arch_mismatch=getattr(args, "allow_arch_mismatch",
+                                            False))
+        except ValueError as e:
+            # quantization-mode / shape stamp refusal: exit loudly instead
+            # of serving a silently cross-mode model
+            print(str(e), file=sys.stderr)
+            return 2
         print(f"restored checkpoint step {ck.step} from "
               f"{args.checkpoint_dir}", file=sys.stderr)
     print(f"serving on {config.serving.host}:{config.serving.port}",
@@ -619,6 +642,10 @@ def cmd_bench(args: argparse.Namespace) -> int:
         return 1
     bench = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(bench)
+    if getattr(args, "quant", False):
+        # quantized pool_scaling (bench.py reads the env in the inner
+        # process; see _pool_scaling_stage)
+        os.environ["RTFD_BENCH_QUANT"] = "1"
     bench.main()
     return 0
 
@@ -855,6 +882,36 @@ def cmd_feedback_drill(args: argparse.Namespace) -> int:
     summary = run_feedback_drill(cfg)
     print(json.dumps(summary), flush=True)
     print(json.dumps(compact_drill_summary(summary),
+                     separators=(",", ":")), flush=True)
+    return 0 if summary["passed"] else 1
+
+
+def cmd_quant_drill(args: argparse.Namespace) -> int:
+    """Deterministic quantization drill (scoring/quant_drill.py): the
+    score-delta oracle gating the quantized scoring plane. One seeded
+    stream through the f32 and the fully quantized fused programs (int8
+    BERT + GEMM-form tree kernels): max score divergence pinned below the
+    measured calibration-noise floor (what the committed bf16 compute
+    policy already moves scores by), zero decision flips at the pinned
+    operating point, quality-protocol AUC unchanged, exact GEMM-vs-gather
+    leaf equality, >= 3.5x smaller BERT param bytes, and a bit-identical
+    second run. Prints the full summary, then a compact (<2 KB) verdict
+    as the FINAL stdout line (bench.py convention). Exit 1 unless every
+    check passed."""
+    import dataclasses as _dc
+
+    from realtime_fraud_detection_tpu.scoring.quant_drill import (
+        QuantDrillConfig,
+        compact_quant_summary,
+        run_quant_drill,
+    )
+
+    cfg = QuantDrillConfig.fast() if args.fast else QuantDrillConfig()
+    cfg = _dc.replace(cfg, seed=args.seed,
+                      replay=not getattr(args, "no_replay", False))
+    summary = run_quant_drill(cfg)
+    print(json.dumps(summary), flush=True)
+    print(json.dumps(compact_quant_summary(summary),
                      separators=(",", ":")), flush=True)
     return 0 if summary["passed"] else 1
 
@@ -1339,6 +1396,10 @@ def build_parser() -> argparse.ArgumentParser:
                          "aware just-in-time batch closing + online "
                          "config tuner replace the fixed assembly "
                          "deadline")
+    sp.add_argument("--quant", action="store_true",
+                    help="quantized scoring plane (models/quant.py): "
+                         "weight-only int8 BERT + GEMM-form tree kernels "
+                         "(the rtfd quant-drill gated configuration)")
     sp.set_defaults(fn=cmd_run_job)
 
     sp = sub.add_parser("serve", help="run the scoring HTTP service")
@@ -1375,7 +1436,13 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--allow-arch-mismatch", action="store_true",
                     help="combine a checkpoint and quality artifact even "
                          "when their recorded text-encoder architectures "
-                         "differ (refused by default)")
+                         "differ, and restore a checkpoint whose recorded "
+                         "quantization mode crosses this server's quant "
+                         "config (both refused by default)")
+    sp.add_argument("--quant", action="store_true",
+                    help="quantized scoring plane (models/quant.py): "
+                         "weight-only int8 BERT + GEMM-form tree kernels "
+                         "(the rtfd quant-drill gated configuration)")
     sp.add_argument("--trace", action="store_true",
                     help="enable the per-transaction tracing plane: "
                          "GET /latency/breakdown, GET /slo, trace_* "
@@ -1539,6 +1606,21 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--seed", type=int, default=7)
     sp.set_defaults(fn=cmd_autotune_drill)
 
+    sp = sub.add_parser("quant-drill",
+                        help="deterministic quantization drill (score-"
+                             "delta oracle): int8 BERT + GEMM-form tree "
+                             "kernels vs the f32 fused program — "
+                             "divergence below calibration noise, zero "
+                             "decision flips, AUC unchanged, bit-"
+                             "identical replay")
+    sp.add_argument("--fast", action="store_true",
+                    help="tier-1 sizes (the CI smoke configuration)")
+    sp.add_argument("--seed", type=int, default=11)
+    sp.add_argument("--no-replay", action="store_true",
+                    help="skip the bit-identical second run (bench "
+                         "stage mode; the replay gate is waived)")
+    sp.set_defaults(fn=cmd_quant_drill)
+
     sp = sub.add_parser("trace-export",
                         help="run a traced fake-Kafka job and export "
                              "Chrome-trace/Perfetto JSON")
@@ -1603,6 +1685,11 @@ def build_parser() -> argparse.ArgumentParser:
     sp.set_defaults(fn=cmd_lint)
 
     sp = sub.add_parser("bench", help="run the TPU benchmark")
+    sp.add_argument("--quant", action="store_true",
+                    help="measure the pool_scaling stage on the "
+                         "quantized scoring plane (int8 BERT + GEMM-form "
+                         "tree kernels); the int8 calibration pulls the "
+                         "f32 weights host-side once at scorer build")
     sp.set_defaults(fn=cmd_bench)
 
     sp = sub.add_parser("health-check", help="probe a running service")

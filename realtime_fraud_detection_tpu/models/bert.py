@@ -98,7 +98,28 @@ def _layer_norm(x, p, eps):
 
 
 def _dense(x, p, compute_dtype):
+    if "qw" in p:
+        # weight-only int8 (models/quant.py): dequantize per-output-channel
+        # right at the compute-dtype seam — XLA fuses the (i8 -> bf16) *
+        # scale widen into the matmul's weight read, so the full-precision
+        # kernel never materializes in HBM
+        w = p["qw"].astype(compute_dtype) * p["scale"].astype(compute_dtype)
+        return x.astype(compute_dtype) @ w + p["b"]
     return x.astype(compute_dtype) @ p["w"].astype(compute_dtype) + p["b"]
+
+
+def _embedding_rows(table, idx=None, length=None):
+    """Embedding lookup that understands both layouts: a bare f32 table,
+    or the quantized ``{"qe": i8[rows, h], "scale": f32[rows]}`` form
+    (per-row scales — the gather's output channel is the row). Returns
+    f32 rows either way; ``idx`` gathers, ``length`` slices a prefix."""
+    if isinstance(table, dict) and "qe" in table:
+        if idx is not None:
+            return (table["qe"][idx].astype(jnp.float32)
+                    * table["scale"][idx][..., None])
+        return (table["qe"][:length].astype(jnp.float32)
+                * table["scale"][:length][:, None])
+    return table[idx] if idx is not None else table[:length]
 
 
 def bert_encode(
@@ -131,7 +152,8 @@ def bert_embed(params: Dict, input_ids: jax.Array,
     """Token + position embeddings with the embedding layer norm — shared
     by the sequential and pipeline-parallel encoders."""
     s = input_ids.shape[1]
-    x = params["word_emb"][input_ids] + params["pos_emb"][:s][None, :, :]
+    x = (_embedding_rows(params["word_emb"], idx=input_ids)
+         + _embedding_rows(params["pos_emb"], length=s)[None, :, :])
     return _layer_norm(x, params["emb_ln"], config.layer_norm_eps)
 
 
@@ -177,11 +199,13 @@ def bert_logits(
     attention_mask: jax.Array,
     config: BertConfig,
     use_pallas: bool = False,
+    compute_dtype=jnp.bfloat16,
     attention_fn=None,
 ) -> jax.Array:
     """Sequence-classification logits f32[B, num_labels] from [CLS]."""
     hidden = bert_encode(params, input_ids, attention_mask, config,
-                         use_pallas, attention_fn=attention_fn)
+                         use_pallas, compute_dtype=compute_dtype,
+                         attention_fn=attention_fn)
     cls = hidden[:, 0, :]
     z = jax.nn.relu(cls @ params["pre_classifier"]["w"] + params["pre_classifier"]["b"])
     return z @ params["classifier"]["w"] + params["classifier"]["b"]
@@ -193,10 +217,16 @@ def bert_predict(
     attention_mask: jax.Array,
     config: BertConfig,
     use_pallas: bool = False,
+    compute_dtype=jnp.bfloat16,
     attention_fn=None,
 ) -> jax.Array:
     """Fraud probability f32[B] = softmax(logits)[:, 1]
-    (bert_text_analyzer.py:216-222)."""
+    (bert_text_analyzer.py:216-222).
+
+    ``compute_dtype`` widens the matmul seam (core/precision.py); the
+    quant drill uses f32 here to measure the calibration-noise floor the
+    committed bf16 policy already accepts."""
     logits = bert_logits(params, input_ids, attention_mask, config,
-                         use_pallas, attention_fn=attention_fn)
+                         use_pallas, compute_dtype=compute_dtype,
+                         attention_fn=attention_fn)
     return jax.nn.softmax(logits, axis=-1)[:, 1]

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -44,27 +45,43 @@ class IsolationForest:
         return self.feature.shape[0]
 
 
-def iforest_scores(forest: IsolationForest, x: jax.Array) -> jax.Array:
-    """Anomaly score s in (0, 1]; higher = more anomalous. f32[B]."""
+def iforest_scores(forest: IsolationForest, x: jax.Array,
+                   kernel: str = "gather") -> jax.Array:
+    """Anomaly score s in (0, 1]; higher = more anomalous. f32[B].
+
+    ``kernel`` selects the traversal (models/trees.py): ``"gather"`` (the
+    oracle) or ``"gemm"`` (Hummingbird-style one-hot contractions over the
+    same complete-tree layout — identical leaves, path lengths summed in
+    a different order, so scores agree to float tolerance).
+    """
     from realtime_fraud_detection_tpu.models.trees import (
         descend_complete_trees,
         gather_leaf_values,
+        gemm_leaf_contract,
     )
 
-    leaf_idx = descend_complete_trees(forest.feature, forest.threshold, x)
-    h = gather_leaf_values(forest.path_length, leaf_idx)  # [B, T]
+    if kernel == "gemm":
+        h = gemm_leaf_contract(forest.feature, forest.threshold,
+                               forest.path_length, x)         # [B, T]
+    elif kernel == "gather":
+        leaf_idx = descend_complete_trees(forest.feature, forest.threshold, x)
+        h = gather_leaf_values(forest.path_length, leaf_idx)  # [B, T]
+    else:
+        raise ValueError(
+            f"iforest kernel must be 'gather' or 'gemm', got {kernel!r}")
     mean_h = h.mean(axis=1)
     return jnp.exp2(-mean_h / forest.c_psi)
 
 
-@jax.jit
-def iforest_predict(forest: IsolationForest, x: jax.Array) -> jax.Array:
+@partial(jax.jit, static_argnames=("kernel",))
+def iforest_predict(forest: IsolationForest, x: jax.Array,
+                    kernel: str = "gather") -> jax.Array:
     """Fraud probability via the reference mapping (model_manager.py:338-346).
 
     decision_function = 0.5 - s (sklearn offset convention), then
     p = 1/(1+exp(decision)).
     """
-    decision = 0.5 - iforest_scores(forest, x)
+    decision = 0.5 - iforest_scores(forest, x, kernel=kernel)
     return 1.0 / (1.0 + jnp.exp(decision))
 
 

@@ -540,6 +540,29 @@ class MetricsCollector:
             "Virtual seconds from a fault window's end to observed plane "
             "recovery", ("fault",))
         self._chaos_seen: Dict[str, float] = {}
+        # quantized scoring plane (models/quant.py + QuantSettings):
+        # SERVED per-branch weight/kernel modes (live-params truth from
+        # FraudScorer.quant_snapshot, not config — the two differ after an
+        # allow_arch_mismatch restore), replicated param bytes, and the
+        # score-delta oracle's verdicts — mirrored by sync_quant at
+        # exposition time (honest counter deltas, same discipline as every
+        # sync_* mirror above)
+        self.quant_branch_mode = r.gauge(
+            "quant_branch_mode",
+            "1 for the weight/kernel mode each branch currently serves "
+            "(f32/int8 for bert_text, gather/gemm for the tree branches)",
+            ("branch", "mode"))
+        self.quant_param_bytes = r.gauge(
+            "quant_param_bytes",
+            "Serialized parameter bytes of the quantizable branch as "
+            "served (the per-replica replication / hot-swap payload)",
+            ("branch",))
+        self.quant_gate_verdicts = r.counter(
+            "quant_gate_verdicts_total",
+            "Divergence-oracle verdicts recorded against this scorer "
+            "(rtfd quant-drill and any caller running the quantized-vs-"
+            "f32 comparison)", ("verdict",))
+        self._quant_seen: Dict[str, float] = {}
 
     def sync_host_stats(self, host_stats: Mapping[str, Any]) -> None:
         """Mirror ``FraudScorer.host_stats()`` into the Prometheus series.
@@ -746,6 +769,37 @@ class MetricsCollector:
                 1.0 if w.get("active") else 0.0, fault=fault)
         for fault, rec_s in (snapshot.get("recovery_s") or {}).items():
             self.chaos_recovery_seconds.set(float(rec_s), fault=str(fault))
+
+    def sync_quant(self, snapshot: Mapping[str, Any]) -> None:
+        """Mirror a ``FraudScorer.quant_snapshot()`` into the quant_*
+        series. Called at exposition time; the scorer's cumulative gate
+        ledger mirrors as counter DELTAS against last-seen values (the
+        honest-counter scheme every sync_* mirror here uses), so a stream
+        job and a serving app syncing the same snapshot expose IDENTICAL
+        series. Branch-mode gauges are exhaustive over the valid modes
+        (the inactive mode reads 0, so a flip is visible as a transition,
+        not a new series appearing)."""
+        from realtime_fraud_detection_tpu.utils.config import (
+            VALID_BERT_WEIGHTS,
+            VALID_TREE_KERNELS,
+        )
+
+        modes = snapshot.get("modes") or {}
+        valid_by_branch = {"bert_text": VALID_BERT_WEIGHTS,
+                           "xgboost_primary": VALID_TREE_KERNELS,
+                           "isolation_forest": VALID_TREE_KERNELS}
+        for branch, served in modes.items():
+            for mode in valid_by_branch.get(branch, (served,)):
+                self.quant_branch_mode.set(
+                    1.0 if mode == served else 0.0,
+                    branch=str(branch), mode=str(mode))
+        for branch, nbytes in (snapshot.get("param_bytes") or {}).items():
+            self.quant_param_bytes.set(float(nbytes), branch=str(branch))
+        for verdict, total in (snapshot.get("gate") or {}).items():
+            delta = float(total) - self._quant_seen.get(verdict, 0.0)
+            if delta > 0:
+                self.quant_gate_verdicts.inc(delta, verdict=str(verdict))
+            self._quant_seen[verdict] = float(total)
 
     # ------------------------------------------------------------- recording
     def record_prediction(self, decision: str, fraud_score: float,

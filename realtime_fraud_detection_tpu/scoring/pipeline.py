@@ -141,6 +141,8 @@ def _score_fused_impl(
     bert_config: BertConfig = TINY_CONFIG,
     use_pallas: bool = False,
     with_model_preds: bool = True,
+    tree_kernel: str = "gather",     # quantized plane (QuantSettings):
+    iforest_kernel: str = "gather",  # gather oracle | Hummingbird GEMM form
 ) -> Dict[str, jax.Array]:
     """Score one microbatch through the full 5-model ensemble.
 
@@ -155,7 +157,8 @@ def _score_fused_impl(
 
     preds = jnp.stack(
         [
-            tree_ensemble_predict(models.trees, features),
+            tree_ensemble_predict(models.trees, features,
+                                  kernel=tree_kernel),
             jax.nn.sigmoid(
                 lstm_logits(models.lstm, batch.history, batch.history_len)
             ),
@@ -171,7 +174,8 @@ def _score_fused_impl(
                     batch.merch_neigh_feat, batch.merch_neigh_mask,
                 )
             ),
-            iforest_predict(models.iforest, features),
+            iforest_predict(models.iforest, features,
+                            kernel=iforest_kernel),
         ],
         axis=1,
     )                                                            # f32[B, M]
@@ -189,7 +193,8 @@ def _score_fused_impl(
 
 score_fused = partial(
     jax.jit,
-    static_argnames=("bert_config", "use_pallas", "with_model_preds"),
+    static_argnames=("bert_config", "use_pallas", "with_model_preds",
+                     "tree_kernel", "iforest_kernel"),
 )(_score_fused_impl)
 
 
@@ -213,6 +218,8 @@ def _score_fused_packed_impl(
     blob_bf16: Optional[jax.Array] = None,  # bf16[B, Wh] — half-width leaves
     bert_config: BertConfig = TINY_CONFIG,
     use_pallas: bool = False,
+    tree_kernel: str = "gather",
+    iforest_kernel: str = "gather",
 ) -> jax.Array:
     """Transfer-optimal fused scorer: packed blobs in, one matrix out.
 
@@ -239,6 +246,7 @@ def _score_fused_packed_impl(
         models, batch, params, model_valid,
         bert_config=bert_config, use_pallas=use_pallas,
         with_model_preds=True,
+        tree_kernel=tree_kernel, iforest_kernel=iforest_kernel,
     )
     cols = [out[name].astype(jnp.float32) for name in OUT_COLUMNS]
     return jnp.concatenate(
@@ -246,7 +254,8 @@ def _score_fused_packed_impl(
 
 
 score_fused_packed = partial(
-    jax.jit, static_argnames=("spec", "bert_config", "use_pallas"),
+    jax.jit, static_argnames=("spec", "bert_config", "use_pallas",
+                              "tree_kernel", "iforest_kernel"),
 )(_score_fused_packed_impl)
 
 # Donated-input variant for the device pool's per-replica dispatch
@@ -259,7 +268,8 @@ score_fused_packed = partial(
 # donate_argnames.
 try:
     score_fused_packed_donated = partial(
-        jax.jit, static_argnames=("spec", "bert_config", "use_pallas"),
+        jax.jit, static_argnames=("spec", "bert_config", "use_pallas",
+                                  "tree_kernel", "iforest_kernel"),
         donate_argnames=("blob_f32", "blob_i32", "blob_u8", "blob_bf16"),
     )(_score_fused_packed_impl)
 except TypeError:  # pragma: no cover - older jax

@@ -300,6 +300,17 @@ class FraudScorer:
             jax.random.PRNGKey(seed), bert_config=bert_config,
             feature_dim=self.sc.feature_dim, node_dim=self.sc.node_dim,
         )
+        # quantized scoring plane (models/quant.py + QuantSettings): the
+        # BERT branch drops to weight-only int8 and the tree branches can
+        # take the GEMM-form kernels. Applied HERE (and in set_models) so
+        # every downstream consumer — the mesh path, the device pool's
+        # per-replica replication, checkpoint save — sees one consistent
+        # parameter form.
+        self.quant = self.config.quant
+        self.models = self._maybe_quantize(self.models)
+        # divergence-gate verdict ledger (rtfd quant-drill records its
+        # oracle verdicts here; obs.metrics.sync_quant mirrors the counts)
+        self._quant_gate_counts: Dict[str, int] = {"pass": 0, "fail": 0}
         self.ensemble_params = EnsembleParams.from_config(self.config, MODEL_NAMES)
         enabled = self.config.get_enabled_models()
         self.model_valid = np.asarray(
@@ -490,6 +501,10 @@ class FraudScorer:
         """Swap the model set (hot reload). Params are replicated onto this
         scorer's mesh — arrays restored from checkpoint arrive committed to
         one device, which would clash with mesh-sharded batch arguments.
+        With the quant plane on, incoming f32 params are quantized FIRST
+        (host-side, before replication), so a hot swap — /reload-models,
+        feedback promotion, drill retrain — always serves this scorer's
+        configured form and the pool fan-out replicates the small blobs.
 
         Clears any attached feature importances: they describe the OLD
         trees; the caller re-attaches via set_feature_importances if it has
@@ -497,12 +512,74 @@ class FraudScorer:
         """
         from realtime_fraud_detection_tpu.core.mesh import replicated_sharding
 
+        models = self._maybe_quantize(models)
         self.models = jax.device_put(models, replicated_sharding(self.mesh))
         self._top_importances = None
         if self._pool is not None:
             # replica-by-replica fan-out; in-flight batches keep the params
             # reference they captured at launch — never mixed within a batch
             self._pool.set_models(models)
+
+    # ------------------------------------------------------------ quantization
+    def _maybe_quantize(self, models: ScoringModels) -> ScoringModels:
+        """Apply the configured weight quantization to an incoming model
+        set (idempotent — already-quantized params pass through). The
+        calibrated pytree is committed back onto the mesh immediately:
+        calibration runs host-side, and leaving numpy leaves in
+        ``self.models`` would re-upload the whole branch H2D on every
+        dispatch of the non-pool path."""
+        if self.quant.bert_mode() != "int8":
+            return models
+        from realtime_fraud_detection_tpu.core.mesh import (
+            replicated_sharding,
+        )
+        from realtime_fraud_detection_tpu.models.quant import (
+            quantize_bert_params,
+        )
+
+        qbert = quantize_bert_params(models.bert)
+        if qbert is models.bert:           # already quantized: no re-put
+            return models
+        return models.replace(
+            bert=jax.device_put(qbert, replicated_sharding(self.mesh)))
+
+    def quant_static(self) -> Dict[str, str]:
+        """The static kernel-selection kwargs for the fused program —
+        threaded into every dispatch (mesh path AND the device pool's
+        per-replica launches). The BERT mode needs no static flag: the
+        compute seam detects the quantized parameter layout structurally."""
+        if not self.quant.enabled:
+            return {"tree_kernel": "gather", "iforest_kernel": "gather"}
+        return {"tree_kernel": self.quant.tree_kernel,
+                "iforest_kernel": self.quant.iforest_kernel}
+
+    def record_quant_gate(self, passed: bool) -> None:
+        """Record a divergence-oracle verdict (rtfd quant-drill / any
+        caller running the quantized-vs-f32 comparison); mirrored to the
+        ``quant_gate_verdicts_total`` Prometheus series by sync_quant."""
+        self._quant_gate_counts["pass" if passed else "fail"] += 1
+
+    def quant_snapshot(self) -> Dict[str, Any]:
+        """Quant-plane observability payload (obs.metrics.sync_quant):
+        the SERVED per-branch modes (read from the live params, not the
+        config — the truth after any allow_arch_mismatch restore), param
+        bytes per quantizable branch, and cumulative gate verdicts."""
+        from realtime_fraud_detection_tpu.models.quant import (
+            bert_param_bytes,
+            is_quantized_bert,
+        )
+
+        static = self.quant_static()
+        return {
+            "modes": {
+                "bert_text": ("int8" if is_quantized_bert(self.models.bert)
+                              else "f32"),
+                "xgboost_primary": static["tree_kernel"],
+                "isolation_forest": static["iforest_kernel"],
+            },
+            "param_bytes": {"bert_text": bert_param_bytes(self.models.bert)},
+            "gate": dict(self._quant_gate_counts),
+        }
 
     # ---------------------------------------------------------------- assembly
     def assemble(self, records: Sequence[Mapping[str, Any]],
@@ -778,6 +855,7 @@ class FraudScorer:
                 model_valid=self._model_valid_dev(mv),
                 blob_bf16=sharded["bf16"],
                 bert_config=self.bert_config, use_pallas=self.sc.use_pallas,
+                **self.quant_static(),
             )
         # Start the device->host copy NOW (it queues behind the compute):
         # by the time finalize() calls device_get, the transfer is already

@@ -576,6 +576,7 @@ class ServingApp:
         # feedback plane's prequential/label/promotion series into the
         # registry at scrape time (cheap gauge sets + counter deltas)
         self.metrics.sync_host_stats(self.scorer.host_stats())
+        self.metrics.sync_quant(self.scorer.quant_snapshot())
         self.metrics.sync_microbatch(self.batcher.close_reasons)
         if self.pool is not None:
             self.metrics.sync_device_pool(self.pool.stats())
@@ -663,10 +664,15 @@ class ServingApp:
                 def _restore():
                     # one shared recipe (checkpoint.restore_into_scorer):
                     # step resolved once, shape-aware template from the
-                    # manifest, swap under the score lock
+                    # manifest, swap under the score lock. The same
+                    # allow_arch_mismatch override also waives the
+                    # quantization-mode stamp check — an int8 checkpoint
+                    # never silently restores into an f32 scorer (409).
                     mgr = CheckpointManager(body["checkpoint_dir"])
                     return mgr.restore_into_scorer(
-                        self.scorer, step=step, lock=self._score_lock)
+                        self.scorer, step=step, lock=self._score_lock,
+                        allow_arch_mismatch=bool(
+                            body.get("allow_arch_mismatch")))
                 try:
                     ck = await loop.run_in_executor(None, _restore)
                 except FileNotFoundError as e:

@@ -527,6 +527,71 @@ class ChaosSettings:
                 "chaos.replica_faults must be >= 1 and slow_device_ms >= 0")
 
 
+VALID_BERT_WEIGHTS = ("f32", "int8")
+VALID_TREE_KERNELS = ("gather", "gemm")
+
+
+@dataclass
+class QuantSettings:
+    """Quantized scoring plane knobs (models/quant.py + the GEMM-form tree
+    kernels in models/trees.py): weight-only int8 for the BERT branch and
+    contraction-form traversal for GBDT / isolation forest, selectable PER
+    BRANCH.
+
+    Disabled by default — the plane is opt-in per deployment (config/JSON
+    overlay, or the bench/tune/soak ``--quant`` switches). Branch modes
+    are STATIC arguments to the fused program: changing them recompiles
+    once (like a combine-strategy change), then every microbatch runs the
+    new kernel. The quality gate is ``rtfd quant-drill``: divergence below
+    calibration noise, zero operating-point decision flips, AUC unchanged
+    on the committed quality protocol — a mode that fails the drill has no
+    business in a config file.
+    """
+
+    enabled: bool = False
+    # BERT branch weights: "f32" (the baseline) or "int8" (weight-only
+    # per-output-channel symmetric quantization, dequant-to-bf16 at the
+    # matmul seam — ~4x smaller replicated params)
+    bert_weights: str = "f32"
+    # GBDT / isolation-forest traversal: "gather" (the D-step gather
+    # oracle) or "gemm" (Hummingbird-style batched contractions)
+    tree_kernel: str = "gather"
+    iforest_kernel: str = "gather"
+
+    def validate(self) -> None:
+        if self.bert_weights not in VALID_BERT_WEIGHTS:
+            raise ValueError(
+                f"quant.bert_weights must be one of {VALID_BERT_WEIGHTS}, "
+                f"got {self.bert_weights!r}")
+        for name, kernel in (("tree_kernel", self.tree_kernel),
+                             ("iforest_kernel", self.iforest_kernel)):
+            if kernel not in VALID_TREE_KERNELS:
+                raise ValueError(
+                    f"quant.{name} must be one of {VALID_TREE_KERNELS}, "
+                    f"got {kernel!r}")
+
+    @classmethod
+    def full(cls) -> "QuantSettings":
+        """The everything-on preset behind the CLI/relay ``--quant``
+        switches: weight-only int8 BERT + GEMM-form kernels for both tree
+        branches — exactly the configuration ``rtfd quant-drill`` gates."""
+        return cls(enabled=True, bert_weights="int8",
+                   tree_kernel="gemm", iforest_kernel="gemm")
+
+    def bert_mode(self) -> str:
+        """The effective BERT weight mode ("f32" when the plane is off)."""
+        return self.bert_weights if self.enabled else "f32"
+
+    def stamp(self) -> Dict[str, str]:
+        """The quantization-mode arch stamp: only the BERT weight form —
+        the one mode that is a PARAMETER property (checkpoint.py derives
+        the same key from saved pytrees via ``_derive_quant_mode`` and
+        refuses silent cross-mode restores on it). The tree kernels are
+        program selections, not checkpoint state, so they are
+        deliberately absent."""
+        return {"bert_weights": self.bert_mode()}
+
+
 @dataclass
 class StateConfig:
     """Windowed state store settings (RedisService.java key TTLs)."""
@@ -636,6 +701,7 @@ class Config:
     tracing: TracingSettings = field(default_factory=TracingSettings)
     tuning: TuningSettings = field(default_factory=TuningSettings)
     chaos: ChaosSettings = field(default_factory=ChaosSettings)
+    quant: QuantSettings = field(default_factory=QuantSettings)
 
     def __post_init__(self) -> None:
         self._apply_env()
@@ -814,6 +880,7 @@ class Config:
         self.tracing.validate()
         self.tuning.validate(qos=self.qos)
         self.chaos.validate()
+        self.quant.validate()
 
 
 def _merge_dataclass(obj: Any, data: Dict[str, Any]) -> None:

@@ -81,6 +81,13 @@ def run() -> None:
 
     gen = TransactionGenerator(num_users=2000, num_merchants=500, seed=3)
     smoke = os.environ.get("RTFD_SOAK_SMOKE") == "1"
+    # --quant: every config serves the quantized scoring plane (weight-
+    # only int8 BERT + GEMM-form tree kernels — the rtfd quant-drill
+    # gated configuration), so one relay window captures f32 and
+    # quantized e2e rates in two invocations. Calibration pulls the f32
+    # weights host-side once per scorer build, before any timed window.
+    quant = "--quant" in sys.argv
+    out["quantized"] = quant
     if smoke:
         # CPU smoke: tiny arch + one config — proves the measurement path
         # end-to-end so a bug can never burn a live relay window
@@ -102,10 +109,17 @@ def run() -> None:
         soak_s = 20.0
     for max_batch, depth, bf16, explain in sweep:
         label = (f"b{max_batch}-d{depth}"
-                 f"{'-bf16' if bf16 else ''}{'-explain' if explain else ''}")
+                 f"{'-bf16' if bf16 else ''}{'-explain' if explain else ''}"
+                 f"{'-quant' if quant else ''}")
         log(f"config {label}: building scorer")
         cfg = Config()
         cfg.ensemble.enable_explanation = explain
+        if quant:
+            from realtime_fraud_detection_tpu.utils.config import (
+                QuantSettings,
+            )
+
+            cfg.quant = QuantSettings.full()
         scorer = FraudScorer(
             config=cfg,
             scorer_config=ScorerConfig(text_len=64, transfer_bf16=bf16),
@@ -152,6 +166,10 @@ def run() -> None:
     log("decomposition: scorer-direct depth-3")
     cfg = Config()
     cfg.ensemble.enable_explanation = False
+    if quant:
+        from realtime_fraud_detection_tpu.utils.config import QuantSettings
+
+        cfg.quant = QuantSettings.full()
     scorer = FraudScorer(config=cfg, scorer_config=ScorerConfig(text_len=64),
                          bert_config=bert_config)
     scorer.seed_profiles(gen.users.profiles(), gen.merchants.profiles())
@@ -189,8 +207,11 @@ def run() -> None:
     best = max(out["configs"], key=lambda e: e["txn_per_s"])
     out["best"] = best
     here = os.path.dirname(os.path.abspath(__file__))
-    path = (os.path.join("/tmp", "MEASUREMENTS_smoke.json") if smoke
-            else os.path.join(here, "MEASUREMENTS_r05_onchip.json"))
+    fname = ("MEASUREMENTS_smoke.json" if smoke
+             else ("MEASUREMENTS_r05_onchip_quant.json" if quant
+                   else "MEASUREMENTS_r05_onchip.json"))
+    path = (os.path.join("/tmp", fname) if smoke
+            else os.path.join(here, fname))
     with open(path, "w") as f:
         json.dump(out, f, indent=2)
     log(f"wrote {path}; best {best['label']} = {best['txn_per_s']} txn/s "

@@ -113,6 +113,14 @@ class TestD2hRule:
     def test_unscoped_module_is_exempt(self):
         assert lint_source(self.SRC, "features/anything.py") == []
 
+    def test_quant_calibrator_is_in_scope(self):
+        """ISSUE 9: models/quant.py joined D2H_MODULES — its host-side
+        calibration sites must carry justified pragmas, and anything
+        unexplained reads as a dispatch-path pull."""
+        findings = lint_source(self.SRC, "models/quant.py")
+        assert rules_of(findings) == ["d2h"]
+        assert lines_of(findings, "d2h") == [4, 5, 6, 7]
+
     def test_scorer_dispatch_scope_is_function_level(self):
         src = ("import numpy as np\n"
                "class FraudScorer:\n"
@@ -228,6 +236,17 @@ class TestDeterminismRule:
     def test_non_drill_module_is_exempt(self):
         src = "import random\nx = random.random()\n"
         assert lint_source(src, "training/x.py") == []
+
+    def test_quant_calibrator_is_in_scope(self):
+        """ISSUE 9: models/quant.py is under the determinism contract —
+        the same f32 weights must always calibrate to the same int8 blobs
+        (replica hot-swap + checkpoint round-trips assume it)."""
+        src = ("import numpy as np\n"
+               "def calibrate(w):\n"
+               "    return w + np.random.standard_normal(w.shape)\n")
+        findings = lint_source(src, "models/quant.py")
+        assert rules_of(findings) == ["determinism"]
+        assert lines_of(findings, "determinism") == [3]
 
 
 class TestPragmaHygiene:

@@ -68,3 +68,43 @@ class TestEmptyChildRegression:
         forest = IsolationForestTrainer(n_estimators=30, seed=3).fit(x)
         s = np.asarray(iforest_scores(forest, x[:50]))
         assert np.isfinite(s).all()
+
+
+class TestGemmKernel:
+    """GEMM-form traversal for the isolation forest (ISSUE 9): identical
+    leaves to the gather oracle on trained forests, path-length sums and
+    final scores inside float tolerance."""
+
+    def test_leaf_equality_trained_forest(self):
+        import jax.numpy as jnp
+
+        from realtime_fraud_detection_tpu.models.trees import (
+            descend_complete_trees,
+            gemm_leaf_index,
+        )
+
+        normal, outliers = _data(seed=11)
+        forest = IsolationForestTrainer(n_estimators=32, seed=11).fit(normal)
+        x = jnp.asarray(np.concatenate([normal[:128], outliers[:32]]))
+        a = descend_complete_trees(forest.feature, forest.threshold, x)
+        b = gemm_leaf_index(forest.feature, forest.threshold, x)
+        assert bool(jnp.all(a == b))
+
+    def test_scores_and_predictions_agree(self):
+        normal, outliers = _data(seed=12)
+        forest = IsolationForestTrainer(n_estimators=32, seed=12).fit(normal)
+        x = np.concatenate([normal[:128], outliers[:32]])
+        s_g = np.asarray(iforest_scores(forest, x, kernel="gather"))
+        s_m = np.asarray(iforest_scores(forest, x, kernel="gemm"))
+        np.testing.assert_allclose(s_g, s_m, atol=1e-5)
+        p_g = np.asarray(iforest_predict(forest, x, kernel="gather"))
+        p_m = np.asarray(iforest_predict(forest, x, kernel="gemm"))
+        np.testing.assert_allclose(p_g, p_m, atol=1e-5)
+
+    def test_unknown_kernel_raises(self):
+        import pytest
+
+        normal, _ = _data(seed=13)
+        forest = IsolationForestTrainer(n_estimators=4, seed=13).fit(normal)
+        with pytest.raises(ValueError, match="kernel"):
+            iforest_scores(forest, normal[:4], kernel="einsum")

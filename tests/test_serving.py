@@ -818,3 +818,49 @@ def test_serving_app_on_shared_state_tier():
         loop.call_soon_threadsafe(loop.stop)
         t.join(timeout=5)
         state.stop()
+
+
+class TestQuantReload:
+    """ISSUE 9 satellite: the quantization-mode arch stamp over HTTP — an
+    int8 checkpoint never silently restores into this (f32) server, the
+    allow_arch_mismatch override serves the checkpoint's actual form, and
+    the quant_* Prometheus series read the live-params truth."""
+
+    def test_cross_mode_reload_409_then_override(self, app_server,
+                                                 tmp_path):
+        import jax
+
+        from realtime_fraud_detection_tpu.checkpoint import (
+            CheckpointManager,
+        )
+        from realtime_fraud_detection_tpu.models.quant import (
+            is_quantized_bert,
+            quantize_bert_params,
+        )
+        from realtime_fraud_detection_tpu.scoring import (
+            init_scoring_models,
+        )
+
+        app, gen = app_server
+        models = init_scoring_models(jax.random.PRNGKey(7))
+        models = models.replace(
+            bert=quantize_bert_params(jax.device_get(models.bert)))
+        CheckpointManager(tmp_path).save(4, params=models)
+
+        status, _ = _request(app.port, "POST", "/reload-models",
+                             {"checkpoint_dir": str(tmp_path)})
+        assert status == 409                     # refused, not silent
+        assert not is_quantized_bert(app.scorer.models.bert)
+
+        status, data = _request(app.port, "POST", "/reload-models",
+                                {"checkpoint_dir": str(tmp_path),
+                                 "allow_arch_mismatch": True})
+        assert status == 200 and data["source"]["step"] == 4
+        assert is_quantized_bert(app.scorer.models.bert)
+        # the service still scores, and observability reports the served
+        # (checkpoint's) form — int8 — not the config's wish
+        status, _ = _request(app.port, "POST", "/predict", _txn(gen))
+        assert status == 200
+        status, text = _request(app.port, "GET", "/metrics/prometheus")
+        assert status == 200
+        assert 'quant_branch_mode{branch="bert_text",mode="int8"} 1' in text

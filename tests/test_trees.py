@@ -140,3 +140,81 @@ class TestFeatureImportances:
         scorer.set_feature_importances(None)
         assert "top_feature_importances" not in (
             scorer.score_batch(gen.generate_batch(4))[0]["explanation"])
+
+
+class TestGemmKernel:
+    """GEMM-form traversal (ISSUE 9, Hummingbird): identical leaves to the
+    gather oracle — exact, on every tested ensemble — with logits inside
+    the documented summation-order tolerance."""
+
+    def _random_ensemble(self, seed, t=12, depth=6, f=16, unsplit=0.3):
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(seed)
+        n_int = 2 ** depth - 1
+        feature = jnp.asarray(rng.integers(0, f, (t, n_int)), jnp.int32)
+        threshold = jnp.where(
+            jnp.asarray(rng.random((t, n_int)) < unsplit), jnp.inf,
+            jnp.asarray(rng.standard_normal((t, n_int)), jnp.float32))
+        leaf = jnp.asarray(rng.standard_normal((t, 2 ** depth)), jnp.float32)
+        return TreeEnsemble(feature=feature, threshold=threshold, leaf=leaf,
+                            base_score=jnp.asarray(0.05, jnp.float32))
+
+    def test_leaf_equality_randomized_ensembles(self):
+        import jax.numpy as jnp
+
+        from realtime_fraud_detection_tpu.models.trees import (
+            descend_complete_trees,
+            gemm_leaf_index,
+        )
+
+        for seed in range(5):
+            ens = self._random_ensemble(seed)
+            x = jnp.asarray(
+                np.random.default_rng(100 + seed).standard_normal((64, 16)),
+                jnp.float32)
+            a = descend_complete_trees(ens.feature, ens.threshold, x)
+            b = gemm_leaf_index(ens.feature, ens.threshold, x)
+            assert bool(jnp.all(a == b)), f"leaf mismatch at seed {seed}"
+
+    def test_leaf_equality_trained_ensemble(self):
+        import jax.numpy as jnp
+
+        from realtime_fraud_detection_tpu.models.trees import (
+            descend_complete_trees,
+            gemm_leaf_index,
+        )
+
+        x, y = _toy_problem(n=2000)
+        ens = GBDTTrainer(n_estimators=16, max_depth=5, seed=0).fit(x, y)
+        xt = jnp.asarray(x[:256])
+        a = descend_complete_trees(ens.feature, ens.threshold, xt)
+        b = gemm_leaf_index(ens.feature, ens.threshold, xt)
+        assert bool(jnp.all(a == b))
+
+    def test_logits_within_tolerance(self):
+        import jax.numpy as jnp
+
+        x, y = _toy_problem(n=2000)
+        trained = GBDTTrainer(n_estimators=16, max_depth=5, seed=0).fit(x, y)
+        for ens, xs in ((trained, x[:256]), (self._random_ensemble(9),
+                                             np.random.default_rng(9)
+                                             .standard_normal((128, 16)))):
+            xt = jnp.asarray(np.asarray(xs, np.float32))
+            lg = np.asarray(tree_ensemble_logits(ens, xt, kernel="gather"))
+            lm = np.asarray(tree_ensemble_logits(ens, xt, kernel="gemm"))
+            # identical leaves, different summation order: float-tolerance
+            # closeness only (the documented GEMM contract)
+            np.testing.assert_allclose(lg, lm, atol=1e-4)
+
+    def test_predictions_agree_and_unknown_kernel_raises(self):
+        import jax.numpy as jnp
+
+        ens = self._random_ensemble(3)
+        x = jnp.asarray(np.random.default_rng(3).standard_normal((32, 16)),
+                        jnp.float32)
+        a = np.asarray(tree_ensemble_predict(ens, x, kernel="gather"))
+        b = np.asarray(tree_ensemble_predict(ens, x, kernel="gemm"))
+        np.testing.assert_allclose(a, b, atol=1e-5)
+        with pytest.raises(ValueError, match="kernel"):
+            tree_ensemble_logits(ens, x, kernel="einsum")

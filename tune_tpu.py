@@ -84,7 +84,13 @@ def main() -> int:
     from realtime_fraud_detection_tpu.utils.config import Config
 
     dev = jax.devices()[0]
-    _emit(stage="start", device=str(dev))
+    # --quant: sweep the QUANTIZED fused program (weight-only int8 BERT +
+    # GEMM-form tree kernels — the rtfd quant-drill gated configuration)
+    # instead of f32, so one relay window captures both sweeps in two
+    # invocations. Calibration pulls the f32 weights host-side ONCE, here
+    # at startup, before any timed section.
+    quant = "--quant" in sys.argv
+    _emit(stage="start", device=str(dev), quantized=quant)
     rng = np.random.default_rng(0)
 
     # 1 ------------------------------------------------- pallas block sweep
@@ -124,13 +130,24 @@ def main() -> int:
           intermediate_size=bert_config.intermediate_size,
           num_heads=bert_config.num_heads,
           vocab_size=bert_config.vocab_size, text_len=sc.text_len)
-    models = jax.device_put(init_scoring_models(
+    models = init_scoring_models(
         jax.random.PRNGKey(0), bert_config=bert_config,
-        feature_dim=sc.feature_dim, node_dim=sc.node_dim))
+        feature_dim=sc.feature_dim, node_dim=sc.node_dim)
+    kernel = "gather"
+    if quant:
+        from realtime_fraud_detection_tpu.models.quant import (
+            quantize_bert_params,
+        )
+
+        models = models.replace(
+            bert=quantize_bert_params(jax.device_get(models.bert)))
+        kernel = "gemm"
+    models = jax.device_put(models)
     params = EnsembleParams.from_config(Config(), list(MODEL_NAMES))
     valid = jnp.ones((len(MODEL_NAMES),), bool)
     fused = jax.jit(lambda m, b, p, v: score_fused(
-        m, b, p, v, bert_config=bert_config, with_model_preds=False))
+        m, b, p, v, bert_config=bert_config, with_model_preds=False,
+        tree_kernel=kernel, iforest_kernel=kernel))
     for bucket in (64, 128, 256, 512, 1024):
         host_batch = make_example_batch(
             bucket, sc, rng=np.random.default_rng(bucket))
@@ -164,8 +181,10 @@ def main() -> int:
                             % bert_config.vocab_size).astype(np.int32))
             for j in range(8)]
     batch = jax.device_put(host_batch)
-    jtree = jax.jit(lambda f: tree_ensemble_predict(models.trees, f))
-    jifo = jax.jit(lambda f: iforest_predict(models.iforest, f))
+    jtree = jax.jit(lambda f: tree_ensemble_predict(models.trees, f,
+                                                    kernel=kernel))
+    jifo = jax.jit(lambda f: iforest_predict(models.iforest, f,
+                                             kernel=kernel))
     jlstm = jax.jit(lambda h: jax.nn.sigmoid(lstm_logits(
         models.lstm, h, batch.history_len)))
     jbert = jax.jit(lambda t: bert_predict(
