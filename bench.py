@@ -208,6 +208,15 @@ def _compact_summary(result: dict) -> dict:
             "high_value_sheds": ch.get("high_value_sheds"),
         } if (ch := result.get("chaos") or {})
             and not ch.get("error") else None),
+        "shard_scaling": ({
+            "single_worker_txn_per_s": sh.get("single_worker_txn_per_s"),
+            "aggregate_txn_per_s": sh.get("aggregate_txn_per_s"),
+            "scaling_vs_single": sh.get("scaling_vs_single"),
+            "scaling_efficiency": sh.get("scaling_efficiency"),
+            "handoff_pause_s": (sh.get("handoff") or {}).get("pause_s"),
+            "handoff_replayed": (sh.get("handoff") or {}).get("replayed"),
+        } if (sh := result.get("shard_scaling") or {})
+            and not sh.get("error") else None),
         "quantization": ({
             "bytes_ratio": (qz.get("param_bytes") or {}).get("ratio"),
             "bert_quant_us_per_txn": ((qz.get("branches") or {}).get(
@@ -250,7 +259,8 @@ def _compact_summary(result: dict) -> dict:
     while len(line.encode()) >= 2048:
         for victim in ("configs_txn_per_s", "operating_point", "quality",
                        "host_assembly", "pool_scaling", "autotune", "chaos",
-                       "quantization", "latest_committed_tpu_capture",
+                       "shard_scaling", "quantization",
+                       "latest_committed_tpu_capture",
                        "text_encoder", "error"):
             if compact.pop(victim, None) is not None:
                 break
@@ -984,6 +994,20 @@ def run_bench() -> None:
         _log(f'chaos stage done: '
              f'{ {k: v for k, v in (result.get("chaos") or {}).items() if not isinstance(v, dict)} }')
 
+    # ------------------------------------------------ shard-scaling stage
+    # Partition-parallel worker plane (cluster/): aggregate virtual txn/s
+    # at 1/2/4 workers + the kill run's handoff pause, from the shard
+    # drill's machinery at fast sizes. Pure host arithmetic on a virtual
+    # clock — safe on any box, including a tunneled TPU session.
+    if remaining() > 30:
+        try:
+            _shard_scaling_stage(result, snapshot)
+        except Exception as e:  # noqa: BLE001
+            result["shard_scaling"] = {
+                "error": f"{type(e).__name__}: {e}"[:200]}
+        _log(f'shard-scaling stage done: '
+             f'{ {k: v for k, v in (result.get("shard_scaling") or {}).items() if not isinstance(v, dict)} }')
+
     # ------------------------------------------------- quantization stage
     # Quantized scoring plane (models/quant.py): per-branch f32-vs-quant
     # µs/txn, param bytes, divergence magnitudes. CPU only — the int8
@@ -1637,6 +1661,23 @@ def _chaos_stage(result: dict, snapshot) -> None:
         "virtual_duration_s": full.get("virtual_duration_s"),
     }
     snapshot("chaos")
+
+
+def _shard_scaling_stage(result: dict, snapshot) -> None:
+    """Partition-parallel worker plane (ISSUE 10 bench satellite):
+    aggregate virtual txn/s at 1/2/4 workers over one saturating seeded
+    schedule vs the single-worker baseline, plus the worker-kill run's
+    handoff pause + state-replay depth. Pure virtual-clock host
+    arithmetic (cluster/drill.run_shard_scaling — no device work, no
+    subprocess), so it is cheap and safe anywhere including a tunneled
+    TPU session; the pass/fail bar lives in ``rtfd shard-drill`` and the
+    tier-1 smoke."""
+    from realtime_fraud_detection_tpu.cluster.drill import (
+        run_shard_scaling,
+    )
+
+    result["shard_scaling"] = run_shard_scaling()
+    snapshot("shard_scaling")
 
 
 def _quantization_stage(result: dict, models, sc, bert_config,

@@ -42,8 +42,10 @@ These rules encode exactly those contracts:
 
 ``determinism``
     No global-RNG ``random.*`` / ``np.random.*`` draws in ``sim/``, any
-    ``*drill*`` module, or the quantization calibrators
-    (``DETERMINISM_MODULES``) — seeded generator instances
+    ``*drill*`` module, the quantization calibrators
+    (``DETERMINISM_MODULES``), or the partition-parallel worker plane
+    (``DETERMINISM_SUBSYSTEMS``: all of ``cluster/`` — ring placement
+    and handoff must replay bit-identically) — seeded generator instances
     (``np.random.default_rng(seed)``, ``random.Random(seed)``,
     ``jax.random.PRNGKey``) only, so every drill replays bit-identically
     and the same weights always calibrate to the same int8 blobs.
@@ -85,7 +87,7 @@ PACKAGE_NAME = "realtime_fraud_detection_tpu"
 # clock read here silently diverges a replay.
 CLOCK_SUBSYSTEMS = frozenset(
     {"qos", "tuning", "feedback", "obs", "stream", "serving", "scoring",
-     "sim"})
+     "sim", "cluster"})
 
 # Whole modules under the pre-pull-safe / dispatch-path d2h contract
 # (utils/timing.py rule 2: only block_until_ready inside timed sections).
@@ -115,6 +117,13 @@ D2H_FUNCTIONS: Dict[str, frozenset] = {
 # the same f32 pytree always quantizes to the same blobs).
 DETERMINISM_MODULES = frozenset({
     "models/quant.py",
+})
+# Whole subsystems under the determinism contract: every cluster/ module
+# is replay-critical — ring placement, partition routing, handoff
+# snapshots, and the shard drill must all be pure functions of their
+# seeds/inputs, or `rtfd shard-drill`'s bit-identical second run lies.
+DETERMINISM_SUBSYSTEMS = frozenset({
+    "cluster",
 })
 
 # Param / degradation-mask mutators: reachable only under the score lock
@@ -732,7 +741,8 @@ def _rule_determinism(ctx: "Context") -> List[Finding]:
     for mod in ctx.modules:
         base = os.path.basename(mod.relpath)
         if not (mod.relpath.startswith("sim/") or "drill" in base
-                or mod.relpath in DETERMINISM_MODULES):
+                or mod.relpath in DETERMINISM_MODULES
+                or mod.subsystem in DETERMINISM_SUBSYSTEMS):
             continue
         for node in ast.walk(mod.tree):
             if not isinstance(node, ast.Call):

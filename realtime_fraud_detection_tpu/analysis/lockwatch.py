@@ -3,8 +3,8 @@
 The static ``lock-order`` rule (analysis/lint.py) sees the lexical
 structure; this module watches what the threads actually do. While any of
 the deterministic drills run (``rtfd lint --lockwatch`` drives pool-drill,
-trace-drill, autotune-drill, feedback-drill, qos-drill and chaos-drill),
-every
+trace-drill, autotune-drill, feedback-drill, qos-drill, chaos-drill and
+shard-drill), every
 ``threading.Lock`` / ``RLock`` / ``Condition`` created from package code
 is replaced by an instrumented wrapper that records, per thread:
 
@@ -45,9 +45,10 @@ _REAL_CONDITION = threading.Condition
 
 PACKAGE_MARKER = "realtime_fraud_detection_tpu"
 
-# the six deterministic drills the watcher is validated against
+# the seven deterministic drills the watcher is validated against
 LOCKWATCH_DRILLS = ("qos-drill", "trace-drill", "autotune-drill",
-                    "feedback-drill", "pool-drill", "chaos-drill")
+                    "feedback-drill", "pool-drill", "chaos-drill",
+                    "shard-drill")
 
 
 class LockWatcher:
@@ -431,7 +432,7 @@ def run_drill_watched(drill: str, fast: bool = True,
 
                 cfg = (PoolDrillConfig.fast() if fast else PoolDrillConfig())
                 passed = bool(run_pool_drill(cfg)["passed"])
-            else:   # chaos-drill
+            elif drill == "chaos-drill":
                 import dataclasses
 
                 from realtime_fraud_detection_tpu.chaos.drill import (
@@ -447,4 +448,18 @@ def run_drill_watched(drill: str, fast: bool = True,
                     ChaosDrillConfig.fast() if fast else ChaosDrillConfig(),
                     replay_check=False)
                 passed = bool(run_chaos_drill(cfg)["passed"])
+            else:   # shard-drill
+                import dataclasses
+
+                from realtime_fraud_detection_tpu.cluster.drill import (
+                    ShardDrillConfig,
+                    run_shard_drill,
+                )
+
+                # single pass for the same reason as chaos-drill; the
+                # oracle run inside still executes (it IS a check)
+                cfg = dataclasses.replace(
+                    ShardDrillConfig.fast() if fast else ShardDrillConfig(),
+                    replay_check=False)
+                passed = bool(run_shard_drill(cfg)["passed"])
     return {"drill": drill, "drill_passed": passed, "lockwatch": w.report()}

@@ -18,6 +18,7 @@ from realtime_fraud_detection_tpu.chaos.faults import (
     FaultWindow,
     LabelStall,
     SlowDevice,
+    WorkerKill,
 )
 
 __all__ = [
@@ -28,4 +29,5 @@ __all__ = [
     "FaultWindow",
     "LabelStall",
     "SlowDevice",
+    "WorkerKill",
 ]

@@ -45,6 +45,7 @@ __all__ = [
     "DeviceReplicaDeath",
     "SlowDevice",
     "LabelStall",
+    "WorkerKill",
 ]
 
 
@@ -235,6 +236,30 @@ class SlowDevice:
 
     def begin(self, now: float) -> None:
         self.pool.inject_slow(self.replica_idx, self.delay_s, self.n)
+
+    def end(self, now: float) -> None:
+        return None
+
+
+class WorkerKill:
+    """Kill a partition-parallel fleet worker (cluster/fleet.WorkerFleet)
+    with process-death semantics: live state and in-flight batches are
+    gone, no graceful flush — the fleet's checkpointed-handoff path
+    (snapshot restore + committed-gap state replay on the survivors) is
+    what recovers. One-shot like :class:`ConsumerMemberKill`: ``end`` is
+    a no-op; the fleet heals by rebalancing, not by resurrection.
+
+    ``target`` is anything with ``kill_worker(worker_id, now=...)`` — the
+    WorkerFleet, or a stub in tests."""
+
+    def __init__(self, target: Any, worker_id: str):
+        self.target = target
+        self.worker_id = worker_id
+        self.killed = 0
+
+    def begin(self, now: float) -> None:
+        self.target.kill_worker(self.worker_id, now=now)
+        self.killed += 1
 
     def end(self, now: float) -> None:
         return None

@@ -1159,9 +1159,42 @@ def _chaos_drill_inprocess(args: argparse.Namespace) -> int:
     return 0 if summary["passed"] else 1
 
 
+def cmd_shard_drill(args: argparse.Namespace) -> int:
+    """Deterministic partition-parallel worker drill (cluster/drill.py):
+    a simulated population (1M users at the full config) scored across
+    >= 4 partition-scoped StreamJob workers sharing one broker log, with
+    a mid-stream worker kill (chaos WorkerKill injector) recovered by
+    checkpointed state handoff + committed-gap state replay. Pins zero
+    lost / double-scored transactions, gap-free committed offsets,
+    per-key ordering, sharded state digest-equal to a single-worker
+    oracle run, consistent-hash router agreement with fleet ownership
+    (only the dead worker's partitions move), and a bit-identical second
+    run. Prints the full summary, then a compact (<2 KB) verdict as the
+    FINAL stdout line (bench.py convention). Exit 1 unless every check
+    passed. Pure host arithmetic on a virtual clock — no device needed."""
+    import dataclasses as _dc
+
+    from realtime_fraud_detection_tpu.cluster.drill import (
+        ShardDrillConfig,
+        compact_shard_summary,
+        run_shard_drill,
+    )
+
+    cfg = ShardDrillConfig.fast() if args.fast else ShardDrillConfig()
+    cfg = _dc.replace(cfg, seed=args.seed,
+                      replay_check=not args.no_replay,
+                      **({"n_workers": args.workers} if args.workers
+                         else {}))
+    summary = run_shard_drill(cfg)
+    print(json.dumps(summary), flush=True)
+    print(json.dumps(compact_shard_summary(summary),
+                     separators=(",", ":")), flush=True)
+    return 0 if summary["passed"] else 1
+
+
 def cmd_lint(args: argparse.Namespace) -> int:
     """Run the repo-native invariant checker (analysis/lint.py) — or, with
-    --lockwatch, the dynamic lock-order watcher under all six
+    --lockwatch, the dynamic lock-order watcher under all seven
     deterministic drills (analysis/lockwatch.py). Exit 0 only when clean.
 
     The static rules (wall-clock, d2h, metrics, lock-order, determinism,
@@ -1666,6 +1699,21 @@ def build_parser() -> argparse.ArgumentParser:
                     help="skip the second bit-identical replay run")
     sp.set_defaults(fn=cmd_chaos_drill)
 
+    sp = sub.add_parser("shard-drill",
+                        help="deterministic partition-parallel worker "
+                             "drill: key-sharded state across >= 4 "
+                             "workers, mid-stream worker kill, "
+                             "checkpointed handoff, oracle state "
+                             "equality")
+    sp.add_argument("--fast", action="store_true",
+                    help="tier-1 sizes (the CI smoke configuration)")
+    sp.add_argument("--workers", type=int, default=0,
+                    help="fleet size (0 = the config default, 4)")
+    sp.add_argument("--seed", type=int, default=7)
+    sp.add_argument("--no-replay", action="store_true",
+                    help="skip the second bit-identical replay run")
+    sp.set_defaults(fn=cmd_shard_drill)
+
     sp = sub.add_parser("lint",
                         help="repo-native invariant checker (static rules "
                              "+ --lockwatch dynamic lock-order watcher)")
@@ -1674,7 +1722,7 @@ def build_parser() -> argparse.ArgumentParser:
                          "+ bench.py)")
     sp.add_argument("--format", choices=("text", "json"), default="text")
     sp.add_argument("--lockwatch", action="store_true",
-                    help="run the five deterministic drills under the "
+                    help="run the seven deterministic drills under the "
                          "instrumented lock watcher instead of the static "
                          "rules")
     sp.add_argument("--lockwatch-run", default="",

@@ -1,0 +1,47 @@
+"""Partition-parallel worker plane: shard the world.
+
+All host state used to live in one ``StreamJob`` process — a cap on the
+user population and a single point of failure. This package makes the
+broker PARTITION the unit of both consumption and state ownership:
+
+- ``cluster.hashring`` — key→partition (the transport's crc32, so broker
+  affinity IS state affinity) and partition→worker (consistent-hash ring,
+  bounded movement on membership change) + the serving ``ShardRouter``;
+- ``cluster.partition`` — the state stores behind a key-partitioned
+  interface (``PartitionedStore``) with snapshot/restore/digest per
+  partition;
+- ``cluster.fleet`` — N partition-scoped StreamJob workers in one
+  consumer group with checkpointed state handoff on worker loss
+  (``WorkerFleet`` / ``HandoffStore``);
+- ``cluster.drill`` — ``rtfd shard-drill``, the deterministic acceptance
+  artifact (1M-user population, mid-stream worker kill, zero lost /
+  double-scored, oracle state equality, bit-identical replay).
+"""
+
+from realtime_fraud_detection_tpu.cluster.hashring import (
+    HashRing,
+    ShardRouter,
+    partition_for_key,
+)
+from realtime_fraud_detection_tpu.cluster.partition import (
+    PartitionNotOwned,
+    PartitionState,
+    PartitionedStore,
+)
+from realtime_fraud_detection_tpu.cluster.fleet import (
+    ClusterWorker,
+    HandoffStore,
+    WorkerFleet,
+)
+
+__all__ = [
+    "HashRing",
+    "ShardRouter",
+    "partition_for_key",
+    "PartitionNotOwned",
+    "PartitionState",
+    "PartitionedStore",
+    "ClusterWorker",
+    "HandoffStore",
+    "WorkerFleet",
+]

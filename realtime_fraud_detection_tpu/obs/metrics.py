@@ -563,6 +563,33 @@ class MetricsCollector:
             "(rtfd quant-drill and any caller running the quantized-vs-"
             "f32 comparison)", ("verdict",))
         self._quant_seen: Dict[str, float] = {}
+        # partition-parallel worker plane (cluster/): fleet membership,
+        # partition ownership, checkpointed-handoff accounting, and the
+        # serving router's key-movement ledger — mirrored from
+        # WorkerFleet.snapshot() (stream side) or the serving app's
+        # router snapshot by sync_cluster at exposition time (honest
+        # counter deltas, same discipline as every sync_* mirror above)
+        self.cluster_workers_alive = r.gauge(
+            "cluster_workers_alive",
+            "Fleet workers currently alive (in the hash ring)")
+        self.cluster_partitions_owned = r.gauge(
+            "cluster_partitions_owned",
+            "Transaction-topic partitions each worker currently owns "
+            "(state ownership == consumption ownership)", ("worker",))
+        self.cluster_handoff = r.counter(
+            "cluster_handoff_total",
+            "Partitions handed off to a surviving worker after a worker "
+            "loss (restore + committed-gap state replay)")
+        self.cluster_handoff_replay_depth = r.gauge(
+            "cluster_handoff_replay_depth",
+            "Records state-replayed during the most recent handoff "
+            "(committed offset minus snapshot offset, summed over the "
+            "moved partitions)")
+        self.cluster_router_moved_keys = r.counter(
+            "cluster_router_moved_keys_total",
+            "Keys (partition moves x key density) the consistent-hash "
+            "serving router re-routed across membership changes")
+        self._cluster_seen: Dict[str, float] = {}
 
     def sync_host_stats(self, host_stats: Mapping[str, Any]) -> None:
         """Mirror ``FraudScorer.host_stats()`` into the Prometheus series.
@@ -800,6 +827,36 @@ class MetricsCollector:
             if delta > 0:
                 self.quant_gate_verdicts.inc(delta, verdict=str(verdict))
             self._quant_seen[verdict] = float(total)
+
+    def sync_cluster(self, snapshot: Mapping[str, Any]) -> None:
+        """Mirror a ``cluster.fleet.WorkerFleet.snapshot()`` (stream
+        side) or the serving app's router snapshot into the cluster_*
+        series. Called at exposition time; cumulative quantities mirror
+        as counter DELTAS against last-seen values (never a negative
+        increment), so a stream job and a serving app syncing the same
+        snapshot render IDENTICAL series. Router-only snapshots simply
+        lack the handoff ledger — those series stay at their last
+        mirrored values."""
+        self.cluster_workers_alive.set(
+            float(snapshot.get("workers_alive", 0)))
+        for wid, w in (snapshot.get("workers") or {}).items():
+            self.cluster_partitions_owned.set(
+                float(w.get("partitions_owned", 0)), worker=str(wid))
+        if "handoffs_total" in snapshot:
+            total = float(snapshot.get("handoffs_total", 0))
+            delta = total - self._cluster_seen.get("handoffs", 0.0)
+            if delta > 0:
+                self.cluster_handoff.inc(delta)
+            self._cluster_seen["handoffs"] = total
+            self.cluster_handoff_replay_depth.set(
+                float(snapshot.get("last_replay_depth", 0)))
+        router = snapshot.get("router") or {}
+        if "moved_keys_total" in router:
+            total = float(router.get("moved_keys_total", 0))
+            delta = total - self._cluster_seen.get("router_moved", 0.0)
+            if delta > 0:
+                self.cluster_router_moved_keys.inc(delta)
+            self._cluster_seen["router_moved"] = total
 
     # ------------------------------------------------------------- recording
     def record_prediction(self, decision: str, fraud_score: float,

@@ -291,6 +291,7 @@ class FraudScorer:
         bert_config: BertConfig = TINY_CONFIG,
         seed: int = 0,
         state_client=None,
+        stores=None,
     ):
         self.config = config or Config()
         self.sc = scorer_config or ScorerConfig()
@@ -348,7 +349,33 @@ class FraudScorer:
             # close() releases it (an explicitly passed client stays the
             # caller's to manage)
             self._owned_state_client = state_client
-        if state_client is not None:
+        if stores is not None:
+            # injected store bundle (cluster/partition.PartitionedStore,
+            # or any object exposing the same four store attributes): the
+            # partition-parallel worker plane hands each worker a scorer
+            # whose state is key-sharded to its owned partitions — the
+            # scorer itself stays shard-oblivious. Mutually exclusive
+            # with the shared RESP tier: both decide where state lives.
+            if state_client is not None:
+                raise ValueError(
+                    "pass either stores= (partitioned state) or "
+                    "state_client= (shared RESP tier), not both")
+            self.profiles = stores.profiles
+            self.velocity = stores.velocity
+            self.txn_cache = stores.txn_cache
+            self.history = stores.history
+            hist_seq = getattr(self.history, "seq_len", self.sc.seq_len)
+            hist_dim = getattr(self.history, "feature_dim",
+                               self.sc.feature_dim)
+            if (hist_seq != self.sc.seq_len
+                    or hist_dim != self.sc.feature_dim):
+                # a mismatched history table would silently gather
+                # wrong-shaped LSTM inputs — refuse at construction
+                raise ValueError(
+                    f"injected history store is ({hist_seq}, {hist_dim})"
+                    f", scorer expects ({self.sc.seq_len}, "
+                    f"{self.sc.feature_dim})")
+        elif state_client is not None:
             from realtime_fraud_detection_tpu.state.shared import (
                 SharedProfileStore,
                 SharedTransactionCache,
@@ -359,11 +386,14 @@ class FraudScorer:
             self.velocity = SharedVelocityStore(state_client)
             self.txn_cache = SharedTransactionCache(state_client,
                                                     **cache_kwargs)
+            self.history = UserHistoryStore(self.sc.seq_len,
+                                            self.sc.feature_dim)
         else:
             self.profiles = ProfileStore()
             self.velocity = VelocityStore()
             self.txn_cache = TransactionCache(**cache_kwargs)
-        self.history = UserHistoryStore(self.sc.seq_len, self.sc.feature_dim)
+            self.history = UserHistoryStore(self.sc.seq_len,
+                                            self.sc.feature_dim)
         self.graph = EntityGraphStore(self.sc.fanout)
         if self.sc.tokenizer == "wordpiece":
             from realtime_fraud_detection_tpu.models.wordpiece import (
@@ -1021,6 +1051,35 @@ class FraudScorer:
                 "explanation": explanation,
             })
         return results
+
+    def replay_state(self, records: Sequence[Mapping[str, Any]],
+                     now: Optional[float] = None) -> None:
+        """State-only replay for the partition-handoff path
+        (cluster/fleet.ClusterWorker): re-apply the state updates of
+        records that were ALREADY scored, emitted, and committed by a
+        worker that died after its last partition snapshot — without
+        re-scoring on device or re-emitting anything.
+
+        ``assemble`` reconstructs the history rings + profile/velocity
+        read path exactly as the dead worker's scoring pass did; the
+        write-back caches each transaction with an explicit marker
+        result (the dead worker's served score is unknowable host-side —
+        unlike the shard drill's deterministic stand-in — so a later
+        duplicate re-emits a REVIEW marker rather than inventing a
+        score). Effectively-once scoring and dedupe are preserved; the
+        marker is honest about what was lost."""
+        if not records:
+            return
+        self.assemble(records, now=now)
+        markers = [{
+            "transaction_id": str(r.get("transaction_id", "")),
+            "fraud_score": 0.5,
+            "decision": "REVIEW",
+            "risk_level": "UNKNOWN",
+            "confidence": 0.0,
+            "explanation": {"replay_restored": True},
+        } for r in records]
+        self._write_back(records, markers, now)
 
     def _write_back(self, records, results, now: Optional[float]) -> None:
         """Post-scoring state updates (RedisTransactionSink.java:53-135)."""

@@ -145,6 +145,24 @@ class ServingApp:
                     self.config.tracing.slo_fast_window_s)
                  if self.tracer is not None else 0.0),
                 (self.qos.effective_level() if self.qos.enabled else 0))
+        # consistent-hash shard router (cluster/hashring.py): with
+        # config.cluster.enabled, /predict serves only users whose
+        # partition the ring assigns to THIS worker_id; other keys get a
+        # 421 naming the owning worker + address. Placement is a pure
+        # function of (workers, n_partitions, virtual_nodes) — every
+        # worker and every ingress computes the same answer with no
+        # coordination traffic.
+        self.cluster_router = None
+        cl = self.config.cluster
+        if cl.enabled:
+            from realtime_fraud_detection_tpu.cluster.hashring import (
+                ShardRouter,
+            )
+
+            self.cluster_router = ShardRouter(
+                cl.n_partitions, sorted(cl.workers),
+                virtual_nodes=cl.virtual_nodes,
+                addresses=dict(cl.workers))
         self.batcher = RequestMicrobatcher(
             self._score_batch_sync,
             max_batch=sc.microbatch_max_size,
@@ -443,6 +461,7 @@ class ServingApp:
         r("GET", "/latency/breakdown", self._latency_breakdown)
         r("GET", "/slo", self._slo_status)
         r("GET", "/autotune", self._autotune_status)
+        r("GET", "/cluster", self._cluster_status)
 
     def _admit(self, n: int) -> None:
         limit = self.config.serving.max_concurrent_predictions
@@ -469,6 +488,21 @@ class ServingApp:
         txn, errors = validate_transaction(body)
         if errors:
             raise HttpError(422, errors)
+        if (self.cluster_router is not None
+                and self.config.cluster.worker_id):
+            # shard affinity ahead of admission: a wrong-shard request
+            # must not burn this worker's QoS tokens or concurrency
+            # slots. 421 Misdirected Request, with the owner's identity
+            # and address so the caller (or the ingress) re-issues once.
+            uid = str(txn.get("user_id", ""))
+            owner = self.cluster_router.route(uid)
+            if owner != self.config.cluster.worker_id:
+                return 421, {
+                    "error": "wrong_shard",
+                    "owner": owner,
+                    "location": self.cluster_router.address_of(owner),
+                    "partition": self.cluster_router.partition_of(uid),
+                }
         if self.qos.enabled:
             # QoS admission ahead of the concurrency gate: a shed is an
             # explicit score-with-reason (200, decision REVIEW, risk_level
@@ -588,7 +622,34 @@ class ServingApp:
             with self._score_lock:
                 snap = self.feedback.snapshot()
             self.metrics.sync_feedback(snap)
+        if self.cluster_router is not None:
+            self.metrics.sync_cluster(self._cluster_snapshot())
         return 200, self.metrics.render_prometheus()
+
+    def _cluster_snapshot(self) -> Dict[str, Any]:
+        """Serving-side cluster snapshot (router truth only — the stream
+        fleet's snapshot additionally carries handoff/checkpoint ledgers;
+        obs.metrics.sync_cluster accepts either shape)."""
+        snap = self.cluster_router.snapshot()
+        return {
+            "workers_alive": len(snap["members"]),
+            "workers": {
+                m: {"partitions_owned": len(snap["assignment"].get(m, ()))}
+                for m in snap["members"]
+            },
+            "router": snap,
+        }
+
+    async def _cluster_status(self, body, query) -> Tuple[int, Any]:
+        """Shard-routing status: this worker's identity, the membership,
+        the partition assignment, and the router's movement ledger."""
+        if self.cluster_router is None:
+            return 200, {"enabled": False}
+        return 200, {
+            "enabled": True,
+            "worker_id": self.config.cluster.worker_id,
+            **self.cluster_router.snapshot(),
+        }
 
     async def _model_info(self, body, query) -> Tuple[int, Any]:
         return 200, self.scorer.model_info()

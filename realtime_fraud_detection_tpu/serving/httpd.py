@@ -29,7 +29,8 @@ Handler = Callable[[Any, Dict[str, str]], Awaitable[Tuple[int, Any]]]
 _REASONS = {200: "OK", 202: "Accepted", 400: "Bad Request", 404: "Not Found",
             405: "Method Not Allowed", 408: "Request Timeout",
             409: "Conflict",
-            413: "Payload Too Large", 422: "Unprocessable Entity",
+            413: "Payload Too Large", 421: "Misdirected Request",
+            422: "Unprocessable Entity",
             500: "Internal Server Error", 501: "Not Implemented",
             503: "Service Unavailable"}
 

@@ -49,6 +49,24 @@ class UserHistoryStore:
         self._table = np.zeros((cap, seq_len, feature_dim), np.float32)
         self._counts = np.zeros((cap,), np.int64)
 
+    def __getstate__(self) -> Dict:
+        """Pickle only the USED slot rows: host-state checkpoints and the
+        partition plane's handoff snapshots (cluster/partition.py) pickle
+        this store per partition, and shipping the pre-allocated capacity
+        would make every handoff blob capacity-sized regardless of
+        occupancy. ``_grow`` doubles from the trimmed size on restore.
+
+        A legacy-layout instance (pre-slot-table ``_rings``/``_count``,
+        re-pickled before ``__setstate__`` ever migrated it) has no slot
+        table to trim — pickle it as-is and let restore migrate."""
+        if "_slots" not in self.__dict__:
+            return dict(self.__dict__)
+        used = max(len(self._slots), 1)
+        state = dict(self.__dict__)
+        state["_table"] = self._table[:used].copy()
+        state["_counts"] = self._counts[:used].copy()
+        return state
+
     def __setstate__(self, state) -> None:
         """Checkpoint migration: pre-slot-table snapshots pickled a dict of
         per-user rings (``_rings``/``_count``). The ring layout is
@@ -178,6 +196,12 @@ class UserHistoryStore:
             return (np.zeros((0, self.seq_len, self.feature_dim), np.float32),
                     np.zeros((0,), np.int32))
         return self._gather_slots(self._slot_ids(user_ids, create=False))
+
+    def user_ids(self) -> List[str]:
+        """Users with any history, in first-seen order — the public
+        iteration seam for state digests (``gather(sorted(user_ids()))``
+        reads every ring without touching the slot internals)."""
+        return list(self._slots)
 
     def __len__(self) -> int:
         return len(self._slots)

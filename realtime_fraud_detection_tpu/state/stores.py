@@ -131,6 +131,14 @@ class VelocityStore:
     def get_all(self, user_id: str, now: float | None = None) -> Dict[str, Dict[str, float]]:
         return {w: self.get(user_id, w, now) for w in VELOCITY_WINDOWS}
 
+    def entries(self) -> List[Tuple[str, str, float, float, float]]:
+        """Sorted raw window rows ``(user_id, window, count, amount,
+        window_start)`` — the public content accessor the partition plane
+        (cluster/partition.py) digests for state-equality checks, so
+        nothing outside this module reaches into ``_state``."""
+        return sorted((uid, w, float(v[0]), float(v[1]), float(v[2]))
+                      for (uid, w), v in self._state.items())
+
     def __len__(self) -> int:
         return len(self._state)
 
@@ -220,6 +228,20 @@ class TransactionCache:
 
     def get_features(self, txn_id: str, now: float | None = None) -> Any:
         return self._backend.get(f"features:{txn_id}", now)
+
+    def entries(self, now: float | None = None) -> List[Tuple[str, Any]]:
+        """Sorted live ``(transaction_id, cached_txn)`` pairs (expired
+        entries excluded against ``now`` when given). Content accessor
+        for the partition plane's state digests — the cache's dedupe
+        semantics stay behind get/cache_transaction."""
+        out = []
+        for key in sorted(self._backend._data):
+            if not key.startswith("transaction:"):
+                continue
+            value = self._backend.get(key, now)
+            if value is not None:
+                out.append((key[len("transaction:"):], value))
+        return out
 
     def get_user_transactions(self, user_id: str, limit: int = 100) -> List[str]:
         return self._user_lists.get(user_id, [])[:limit]

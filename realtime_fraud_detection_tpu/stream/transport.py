@@ -184,8 +184,14 @@ class InMemoryBroker:
 
     # -------------------------------------------------------------- consume
     def consumer(self, topics: Sequence[str], group_id: str,
-                 faults: Optional[FaultInjector] = None) -> "Consumer":
-        return Consumer(self, list(topics), group_id, faults)
+                 faults: Optional[FaultInjector] = None,
+                 partitions: Optional[Mapping[str, Sequence[int]]] = None,
+                 ) -> "Consumer":
+        """``partitions`` scopes the consumer to an explicit topic →
+        partition-list assignment (the partition-parallel worker plane,
+        cluster/fleet.py) instead of every partition of every topic."""
+        return Consumer(self, list(topics), group_id, faults,
+                        partitions=partitions)
 
     def end_offsets(self, topic: str) -> List[int]:
         return [len(p.records) for p in self._logs(topic)]
@@ -220,14 +226,26 @@ class Consumer:
     the *position* (not yet committed); ``commit`` durably advances the
     group offset. ``seek_to_committed`` rewinds to the last commit —
     the crash-recovery path.
+
+    With an explicit ``partitions`` assignment (topic → partition list)
+    the consumer reads ONLY those partitions — the partition-parallel
+    worker plane's affinity contract (cluster/): N workers in one group,
+    each scoped to a disjoint partition set. ``set_assignment`` adopts a
+    new assignment mid-life (rebalance) and rewinds the new partitions to
+    their committed offsets, exactly like a fresh member would.
     """
 
     def __init__(self, broker: InMemoryBroker, topics: List[str],
-                 group_id: str, faults: Optional[FaultInjector] = None):
+                 group_id: str, faults: Optional[FaultInjector] = None,
+                 partitions: Optional[Mapping[str, Sequence[int]]] = None):
         self.broker = broker
         self.topics = topics
         self.group_id = group_id
         self.faults = faults
+        self._assignment: Optional[Dict[str, List[int]]] = (
+            {t: sorted(int(p) for p in parts)
+             for t, parts in partitions.items()}
+            if partitions is not None else None)
         self._position: Dict[tuple, int] = {}
         # networked brokers expose a monotonic reconnect epoch; each
         # consumer tracks its OWN last-seen value, so every consumer
@@ -236,11 +254,40 @@ class Consumer:
         self._seen_epoch = self._epoch_fn() if self._epoch_fn else 0
         self.seek_to_committed()
 
+    def _assigned(self, topic: str) -> Sequence[int]:
+        if self._assignment is not None:
+            return self._assignment.get(topic, ())
+        return range(self.broker.partitions(topic))
+
+    def set_assignment(self,
+                       partitions: Mapping[str, Sequence[int]]) -> None:
+        """Adopt a new explicit partition assignment (rebalance).
+
+        Cooperative-sticky semantics: partitions RETAINED across the
+        change keep their in-memory positions (rewinding them would
+        re-poll records already sitting in the owner's assembler or in
+        flight — a storm of cached-dup re-emissions for no safety gain);
+        newly ACQUIRED partitions start from their committed offsets (the
+        handoff contract: state was restored/replayed exactly to there);
+        released partitions drop out of the position map."""
+        self._assignment = {t: sorted(int(p) for p in parts)
+                            for t, parts in partitions.items()}
+        old = self._position
+        self._position = {
+            (t, p): old.get((t, p),
+                            self.broker.committed(self.group_id, t, p))
+            for t, parts in self._assignment.items()
+            for p in parts
+        }
+
+    def assigned_partitions(self) -> Dict[str, List[int]]:
+        return {t: list(self._assigned(t)) for t in self.topics}
+
     def seek_to_committed(self) -> None:
         self._position = {
             (t, p): self.broker.committed(self.group_id, t, p)
             for t in self.topics
-            for p in range(self.broker.partitions(t))
+            for p in self._assigned(t)
         }
 
     def poll(self, max_records: int = 256) -> List[Record]:
@@ -308,7 +355,16 @@ class Consumer:
             self._position[(t, int(p))] = int(off)
 
     def lag(self) -> int:
-        return sum(self.broker.lag(self.group_id, t) for t in self.topics)
+        """Uncommitted lag over THIS consumer's assigned partitions (all
+        partitions when unscoped) — a fleet of scoped consumers summing
+        their lags must count each partition once, not once per worker."""
+        total = 0
+        for t in self.topics:
+            ends = self.broker.end_offsets(t)
+            for p in self._assigned(t):
+                total += max(0, ends[p] - self.broker.committed(
+                    self.group_id, t, p))
+        return total
 
 
 def KafkaTransport(bootstrap_servers: str = "localhost:9092", **kwargs):

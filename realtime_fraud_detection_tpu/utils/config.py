@@ -527,6 +527,56 @@ class ChaosSettings:
                 "chaos.replica_faults must be >= 1 and slow_device_ms >= 0")
 
 
+@dataclass
+class ClusterSettings:
+    """Partition-parallel worker plane knobs (cluster/): key-sharded
+    state, checkpointed handoff, and the consistent-hash serving router.
+
+    ``enabled`` turns on the serving-side router: this process serves
+    ``/predict`` only for users whose partition the ring assigns to
+    ``worker_id``; other keys answer 421 with the owning worker's
+    address (``workers``), so a dumb HTTP client — or the ingress in
+    front of the fleet — re-issues to the right shard. The
+    partition↔worker placement is a pure function of (workers,
+    n_partitions, virtual_nodes), identical in every process. The
+    stream-side fleet (``cluster.fleet.WorkerFleet``) reads
+    ``checkpoint_every`` for its handoff snapshot cadence.
+    """
+
+    enabled: bool = False
+    # must match the transactions topic's partition count — the key →
+    # partition hash is the transport's (stream/topics.py: 12)
+    n_partitions: int = 12
+    virtual_nodes: int = 256
+    # completed batches between per-partition handoff snapshots
+    # (round-robin over owned partitions; see ClusterWorker)
+    checkpoint_every: int = 8
+    # this process's identity in the ring ("" = not a fleet member)
+    worker_id: str = ""
+    # worker_id -> base URL, the router's redirect targets; the ring is
+    # built over these ids
+    workers: Dict[str, str] = field(default_factory=dict)
+
+    def validate(self) -> None:
+        if self.n_partitions < 1:
+            raise ValueError(
+                f"cluster.n_partitions must be >= 1, got "
+                f"{self.n_partitions}")
+        if self.virtual_nodes < 1 or self.checkpoint_every < 1:
+            raise ValueError(
+                "cluster.virtual_nodes and cluster.checkpoint_every "
+                "must be >= 1")
+        if self.enabled:
+            if not self.workers:
+                raise ValueError(
+                    "cluster.enabled requires a non-empty cluster.workers "
+                    "map (worker_id -> base URL)")
+            if self.worker_id and self.worker_id not in self.workers:
+                raise ValueError(
+                    f"cluster.worker_id {self.worker_id!r} missing from "
+                    f"cluster.workers {sorted(self.workers)}")
+
+
 VALID_BERT_WEIGHTS = ("f32", "int8")
 VALID_TREE_KERNELS = ("gather", "gemm")
 
@@ -702,6 +752,7 @@ class Config:
     tuning: TuningSettings = field(default_factory=TuningSettings)
     chaos: ChaosSettings = field(default_factory=ChaosSettings)
     quant: QuantSettings = field(default_factory=QuantSettings)
+    cluster: ClusterSettings = field(default_factory=ClusterSettings)
 
     def __post_init__(self) -> None:
         self._apply_env()
@@ -881,6 +932,7 @@ class Config:
         self.tuning.validate(qos=self.qos)
         self.chaos.validate()
         self.quant.validate()
+        self.cluster.validate()
 
 
 def _merge_dataclass(obj: Any, data: Dict[str, Any]) -> None:
