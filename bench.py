@@ -179,6 +179,14 @@ def _compact_summary(result: dict) -> dict:
             "scaling_efficiency": ps.get("scaling_efficiency"),
             "error": (str(ps["error"])[:120] if ps.get("error") else None),
         } if ps else None),
+        "mesh_scaling": ({
+            "placements": {
+                name: {"txn_per_s": p.get("txn_per_s"),
+                       "per_chip_param_frac": p.get("per_chip_param_frac")}
+                for name, p in (ms.get("placements") or {}).items()},
+            "n_devices": ms.get("n_devices"),
+            "error": (str(ms["error"])[:120] if ms.get("error") else None),
+        } if (ms := result.get("mesh_scaling") or {}) else None),
         "host_assembly": ({
             "columnar_us_per_txn": ha.get("columnar_us_per_txn"),
             "serial_us_per_txn": ha.get("serial_us_per_txn"),
@@ -258,7 +266,8 @@ def _compact_summary(result: dict) -> dict:
     line = json.dumps(compact, separators=(",", ":"))
     while len(line.encode()) >= 2048:
         for victim in ("configs_txn_per_s", "operating_point", "quality",
-                       "host_assembly", "pool_scaling", "autotune", "chaos",
+                       "host_assembly", "mesh_scaling", "pool_scaling",
+                       "autotune", "chaos",
                        "shard_scaling", "quantization",
                        "latest_committed_tpu_capture",
                        "text_encoder", "error"):
@@ -932,6 +941,24 @@ def run_bench() -> None:
         _log(f'pool-scaling stage done: '
              f'{ {k: v for k, v in (result.get("pool_scaling") or {}).items() if not isinstance(v, (dict, list))} }')
 
+    # ------------------------------------------------- mesh-scaling stage
+    # GSPMD data x model serving (scoring/mesh_executor.py): replicated vs
+    # data-sharded vs data x model txn/s + per-chip param bytes from the
+    # committed shardings. Pre-pull safe (complete_no_fetch only). On the
+    # CPU fallback it always runs (the honest model-sharding-may-lose
+    # number); on a tunneled TPU it is opt-in via --mesh so the relay
+    # window's budget stays the operator's choice.
+    if ((not on_tpu or os.environ.get("RTFD_BENCH_MESH") == "1")
+            and remaining() > 60):
+        try:
+            _mesh_scaling_stage(result, models, sc, bert_config, use_pallas,
+                                it, snapshot)
+        except Exception as e:  # noqa: BLE001
+            result["mesh_scaling"] = {
+                "error": f"{type(e).__name__}: {e}"[:200]}
+        _log(f'mesh-scaling stage done: '
+             f'{ {k: v for k, v in (result.get("mesh_scaling") or {}).items() if not isinstance(v, (dict, list))} }')
+
     # ------------------------------------------------- host-assembly stage
     # Columnar vs record-at-a-time assemble throughput + cache hit rates +
     # (CPU) assembler-stage overlap. The assemble comparison is host-only
@@ -1382,6 +1409,127 @@ def _pool_scaling_stage(result: dict, models, sc, bert_config,
                 d["dispatched"] for d in agg_st["devices"]]
     result["pool_scaling"] = entry
     snapshot("pool_scaling")
+
+
+def _mesh_scaling_stage(result: dict, models, sc, bert_config,
+                        use_pallas: bool, it, snapshot) -> None:
+    """GSPMD mesh-sharded serving throughput (scoring/mesh_executor.py).
+
+    Three placements over the same packed microbatch stream, all
+    pre-pull-safe (slots drain via complete_no_fetch — block_until_ready
+    only, never device_get):
+
+    - ``replicated``: one device, everything replicated (the baseline the
+      other two are normalized against);
+    - ``data_sharded``: one mesh over every addressable device, batch
+      split over ``data``, params replicated;
+    - ``data_x_model``: the same mesh reshaped to data x 2, BERT branch
+      params STORED sharded over ``model`` and re-gathered at use.
+
+    The honest caveat rides in the entry: model-sharding is an HBM bet
+    (per-chip param bytes, reported from the committed shardings), not a
+    CPU-throughput bet — the gather collective costs real time and on a
+    virtual-device CPU host it usually LOSES, exactly like the GEMM-form
+    tree kernels. The memory win is the number that must hold everywhere.
+    """
+    from collections import deque
+
+    import jax
+
+    from realtime_fraud_detection_tpu.core.packing import pack_tree
+    from realtime_fraud_detection_tpu.scoring import (
+        FraudScorer,
+        MeshExecutor,
+        make_example_batch,
+    )
+
+    devices = jax.devices()
+    batch = 256
+    depth = 2
+    base = make_example_batch(batch, sc, rng=np.random.default_rng(19))
+    blobs, spec = pack_tree(base)
+    quantized = os.environ.get("RTFD_BENCH_QUANT") == "1"
+    if quantized:
+        from realtime_fraud_detection_tpu.utils.config import (
+            Config,
+            QuantSettings,
+        )
+
+        scorer = FraudScorer(Config(quant=QuantSettings.full()),
+                             models=models, scorer_config=sc,
+                             bert_config=bert_config)
+    else:
+        scorer = FraudScorer(models=models, scorer_config=sc,
+                             bert_config=bert_config)
+    scorer.sc.use_pallas = use_pallas
+    f32 = blobs["f32"]
+
+    def blob_variant(i: int) -> dict:
+        out = dict(blobs)
+        out["f32"] = f32 + np.float32(i) * 1e-4
+        return out
+
+    def measure(iters: int, **kwargs):
+        ex = MeshExecutor(scorer, inflight_depth=depth, **kwargs)
+        ens = scorer.ensemble_params
+        mv = scorer.effective_model_valid()
+        try:
+            warm = [ex.dispatch_packed(blob_variant(j), spec, ens, mv)
+                    for j in range(max(2, len(ex)))]
+            for t in warm:
+                ex.complete_no_fetch(t)
+            inflight: deque = deque()
+            t0 = time.perf_counter()
+            for i in range(iters):
+                inflight.append(
+                    ex.dispatch_packed(blob_variant(i), spec, ens, mv))
+                while len(inflight) >= ex.total_slots():
+                    ex.complete_no_fetch(inflight.popleft())
+            while inflight:
+                ex.complete_no_fetch(inflight.popleft())
+            dt = time.perf_counter() - t0
+        finally:
+            scorer.attach_pool(None)
+        bert_pb = ex.param_bytes()["bert_text"]
+        return {
+            "txn_per_s": round(iters * batch / dt, 1),
+            "bert_param_bytes_per_chip": bert_pb["per_chip"],
+            "bert_param_bytes_replicated": bert_pb["replicated"],
+        }
+
+    iters = it(30)
+    entry: dict = {
+        "batch": batch,
+        "inflight_depth": depth,
+        "n_devices": len(devices),
+        "quantized": quantized,
+        "note": ("model-sharding is an HBM/FLOPs bet like the GEMM-form "
+                 "tree kernels: the per-chip param-byte shrink holds on "
+                 "every backend; the throughput column only pays off "
+                 "where HBM or per-chip FLOPs were the binding "
+                 "constraint — on CPU it may lose to the gather cost"),
+        "placements": {},
+    }
+    entry["placements"]["replicated"] = measure(
+        iters, devices=devices[:1], model_axis=1, shard_branches=())
+    if len(devices) > 1:
+        entry["placements"]["data_sharded"] = measure(
+            iters * 2, model_axis=1, shard_branches=())
+        if len(devices) % 2 == 0:
+            entry["placements"]["data_x_model"] = measure(
+                iters * 2, model_axis=2, shard_branches=("bert_text",))
+    else:
+        entry["note"] += ("; 1 addressable device: sharded placements "
+                          "need a multi-chip relay window (the 8-virtual-"
+                          "device CPU bar is `rtfd mesh-drill`)")
+    base_tps = entry["placements"]["replicated"]["txn_per_s"]
+    for name, p in entry["placements"].items():
+        p["vs_replicated"] = round(p["txn_per_s"] / max(base_tps, 1e-9), 3)
+        p["per_chip_param_frac"] = round(
+            p["bert_param_bytes_per_chip"]
+            / max(p["bert_param_bytes_replicated"], 1), 4)
+    result["mesh_scaling"] = entry
+    snapshot("mesh_scaling")
 
 
 def _host_assembly_stage(result: dict, on_tpu: bool, remaining,
@@ -1961,12 +2109,18 @@ def main() -> None:
         # quantized pool_scaling (the rtfd quant-drill gated config);
         # propagates to the inner process through the inherited env
         os.environ["RTFD_BENCH_QUANT"] = "1"
+    if "--mesh" in sys.argv:
+        # mesh_scaling on a tunneled TPU (always-on for CPU runs);
+        # propagates to the inner process through the inherited env
+        os.environ["RTFD_BENCH_MESH"] = "1"
     orchestrate()
 
 
 if __name__ == "__main__":
     if "--quant" in sys.argv:
         os.environ["RTFD_BENCH_QUANT"] = "1"
+    if "--mesh" in sys.argv:
+        os.environ["RTFD_BENCH_MESH"] = "1"
     if "--inner" in sys.argv:
         run_bench()
     else:

@@ -104,6 +104,13 @@ D2H_MODULES = frozenset({
     # pulling both programs' scores host-side; determinism scope still
     # applies via the *drill* name convention.)
     "models/quant.py",
+    # mesh-sharded serving plane (ISSUE 11): the executor's dispatch path
+    # is under the same pre-pull contract as the pool's — wait() is the
+    # designated pull, complete_no_fetch drains via block_until_ready
+    # (scoring/mesh_drill.py rides the *drill* determinism convention and
+    # is an oracle harness like pool_drill, which is already here).
+    "scoring/mesh_executor.py",
+    "scoring/mesh_drill.py",
 })
 # Function-scoped d2h contract: the scorer's dispatch half must stay
 # pull-free (finalize is the designated pull point).

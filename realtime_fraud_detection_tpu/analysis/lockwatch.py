@@ -3,8 +3,8 @@
 The static ``lock-order`` rule (analysis/lint.py) sees the lexical
 structure; this module watches what the threads actually do. While any of
 the deterministic drills run (``rtfd lint --lockwatch`` drives pool-drill,
-trace-drill, autotune-drill, feedback-drill, qos-drill, chaos-drill and
-shard-drill), every
+trace-drill, autotune-drill, feedback-drill, qos-drill, chaos-drill,
+shard-drill and mesh-drill), every
 ``threading.Lock`` / ``RLock`` / ``Condition`` created from package code
 is replaced by an instrumented wrapper that records, per thread:
 
@@ -45,10 +45,10 @@ _REAL_CONDITION = threading.Condition
 
 PACKAGE_MARKER = "realtime_fraud_detection_tpu"
 
-# the seven deterministic drills the watcher is validated against
+# the eight deterministic drills the watcher is validated against
 LOCKWATCH_DRILLS = ("qos-drill", "trace-drill", "autotune-drill",
                     "feedback-drill", "pool-drill", "chaos-drill",
-                    "shard-drill")
+                    "shard-drill", "mesh-drill")
 
 
 class LockWatcher:
@@ -376,10 +376,11 @@ def run_drill_watched(drill: str, fast: bool = True,
     """Run one deterministic drill under the watcher; return
     ``{"drill", "drill_passed", "lockwatch": report}``.
 
-    pool-drill and chaos-drill need a multi-device host platform —
-    callers (the ``rtfd lint --lockwatch`` parent) re-exec them into a
-    child with ``XLA_FLAGS=--xla_force_host_platform_device_count=N``;
-    the other four run on whatever platform is live.
+    pool-drill, chaos-drill and mesh-drill need a multi-device host
+    platform — callers (the ``rtfd lint --lockwatch`` parent) re-exec
+    them into a child with
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=N``; the other
+    five run on whatever platform is live.
     """
     import contextlib
     import io
@@ -448,7 +449,7 @@ def run_drill_watched(drill: str, fast: bool = True,
                     ChaosDrillConfig.fast() if fast else ChaosDrillConfig(),
                     replay_check=False)
                 passed = bool(run_chaos_drill(cfg)["passed"])
-            else:   # shard-drill
+            elif drill == "shard-drill":
                 import dataclasses
 
                 from realtime_fraud_detection_tpu.cluster.drill import (
@@ -462,4 +463,19 @@ def run_drill_watched(drill: str, fast: bool = True,
                     ShardDrillConfig.fast() if fast else ShardDrillConfig(),
                     replay_check=False)
                 passed = bool(run_shard_drill(cfg)["passed"])
+            else:   # mesh-drill
+                import dataclasses
+
+                from realtime_fraud_detection_tpu.scoring.mesh_drill import (
+                    MeshDrillConfig,
+                    run_mesh_drill,
+                )
+
+                # single pass for the same reason as chaos-drill: the
+                # replay digest is the drill's OWN acceptance; under the
+                # watcher it would only double the wall time
+                cfg = dataclasses.replace(
+                    MeshDrillConfig.fast() if fast else MeshDrillConfig(),
+                    replay_check=False)
+                passed = bool(run_mesh_drill(cfg)["passed"])
     return {"drill": drill, "drill_passed": passed, "lockwatch": w.report()}

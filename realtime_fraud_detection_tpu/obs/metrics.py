@@ -590,6 +590,46 @@ class MetricsCollector:
             "Keys (partition moves x key density) the consistent-hash "
             "serving router re-routed across membership changes")
         self._cluster_seen: Dict[str, float] = {}
+        # mesh-sharded scoring plane (scoring/mesh_executor.py): mesh
+        # geometry, per-branch placement as exhaustive 0/1 gauges (a
+        # placement flip reads as a transition, not a new series — the
+        # quant_branch_mode discipline), per-chip vs replicated param
+        # bytes read from the COMMITTED shardings, and per-mesh-replica
+        # dispatch counters — mirrored from MeshExecutor.mesh_snapshot()
+        # by sync_mesh at exposition time (honest counter deltas, same
+        # discipline as every sync_* mirror above)
+        self.mesh_data_axis = r.gauge(
+            "mesh_data_axis_size",
+            "Data-parallel axis size of each serving mesh replica")
+        self.mesh_model_axis = r.gauge(
+            "mesh_model_axis_size",
+            "Model-parallel axis size of each serving mesh replica")
+        self.mesh_replica_count = r.gauge(
+            "mesh_replica_count",
+            "Mesh replicas in the executor's round-robin rotation "
+            "(pool x mesh: replicate the mesh, not the chip)")
+        self.mesh_branch_sharded = r.gauge(
+            "mesh_branch_sharded",
+            "1 when the branch's params store sharded over the model "
+            "axis, 0 when replicated (exhaustive over the registry)",
+            ("branch",))
+        self.mesh_param_bytes = r.gauge(
+            "mesh_param_bytes_per_chip",
+            "Max per-chip resident param bytes for each branch as "
+            "committed on mesh replica 0 (the HBM the placement actually "
+            "buys)", ("branch",))
+        self.mesh_param_bytes_replicated = r.gauge(
+            "mesh_param_bytes_replicated",
+            "Replicated-equivalent param bytes per branch (what a pure "
+            "DevicePool replica would hold) — the denominator of the "
+            "sharding win", ("branch",))
+        self.mesh_dispatched = r.counter(
+            "mesh_dispatched_total",
+            "Microbatches dispatched to each mesh replica", ("replica",))
+        self.mesh_completed = r.counter(
+            "mesh_completed_total",
+            "Microbatches completed by each mesh replica", ("replica",))
+        self._mesh_seen: Dict[Tuple[str, str], float] = {}
 
     def sync_host_stats(self, host_stats: Mapping[str, Any]) -> None:
         """Mirror ``FraudScorer.host_stats()`` into the Prometheus series.
@@ -827,6 +867,34 @@ class MetricsCollector:
             if delta > 0:
                 self.quant_gate_verdicts.inc(delta, verdict=str(verdict))
             self._quant_seen[verdict] = float(total)
+
+    def sync_mesh(self, snapshot: Mapping[str, Any]) -> None:
+        """Mirror a ``MeshExecutor.mesh_snapshot()`` into the mesh_*
+        series. Called at exposition time (the executor's dispatch path
+        never touches the metrics lock); the cumulative per-replica
+        dispatch/completion counts mirror as counter DELTAS against
+        last-seen values — the honest-counter scheme every sync_* mirror
+        here uses — so a stream job and a serving app syncing the same
+        snapshot render IDENTICAL series."""
+        self.mesh_data_axis.set(float(snapshot.get("data_axis", 0)))
+        self.mesh_model_axis.set(float(snapshot.get("model_axis", 0)))
+        self.mesh_replica_count.set(float(snapshot.get("replicas", 0)))
+        for branch, placement in (snapshot.get("placement") or {}).items():
+            self.mesh_branch_sharded.set(
+                1.0 if placement == "sharded" else 0.0, branch=str(branch))
+        for branch, pb in (snapshot.get("param_bytes") or {}).items():
+            self.mesh_param_bytes.set(float(pb.get("per_chip", 0)),
+                                      branch=str(branch))
+            self.mesh_param_bytes_replicated.set(
+                float(pb.get("replicated", 0)), branch=str(branch))
+        for kind, counter in (("dispatched", self.mesh_dispatched),
+                              ("completed", self.mesh_completed)):
+            for replica, total in (snapshot.get(kind) or {}).items():
+                key = (kind, str(replica))
+                delta = float(total) - self._mesh_seen.get(key, 0.0)
+                if delta > 0:
+                    counter.inc(delta, replica=str(replica))
+                self._mesh_seen[key] = float(total)
 
     def sync_cluster(self, snapshot: Mapping[str, Any]) -> None:
         """Mirror a ``cluster.fleet.WorkerFleet.snapshot()`` (stream

@@ -18,9 +18,10 @@ Layout policy:
 
 from __future__ import annotations
 
-from typing import Any, Dict
+from typing import Any, Dict, Sequence
 
 import jax
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from realtime_fraud_detection_tpu.core.mesh import DATA_AXIS, MODEL_AXIS
@@ -86,6 +87,163 @@ def tree_specs_to_shardings(mesh: Mesh, specs: Any) -> Any:
         lambda s: _named(mesh, s),
         specs,
         is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Serving-plane STORAGE specs (scoring/mesh_executor.py)
+#
+# The mesh serving path has a contract the training specs above do not:
+# scores must be BIT-IDENTICAL to single-device scoring (rtfd mesh-drill
+# pins it). Megatron-style row-parallel compute ends each block in a
+# partial-sum all-reduce, which reorders float additions — allclose-safe
+# (the dryrun's TP gate) but not bit-safe. So the serving plane shards the
+# BYTES, not the math: params live sharded over ``model`` at rest (the
+# per-chip HBM win — the cap the ROADMAP names) and the fused program
+# re-gathers each sharded branch at its use seam
+# (mesh_executor._regather_models — ZeRO-3/FSDP semantics). The all-gather
+# reconstructs exact bytes, every branch then computes replicated per
+# model shard, and the batch stays sharded over ``data`` (the FLOPs win).
+#
+# The specs below keep the Megatron COLUMN/ROW positions anyway (q/k/v/
+# ffn1 split the output feature dim, o/ffn2 the input dim, embeddings the
+# vocab/position rows) so flipping a branch to true compute-sharding later
+# is a one-line gather removal, not a re-layout. Every sharded dim is
+# guarded for divisibility by the model-axis size — an indivisible leaf
+# falls back to replicated rather than failing the device_put.
+# ---------------------------------------------------------------------------
+
+# ScoringModels fields that can take the sharded placement, keyed by the
+# registry branch names (scoring/pipeline.MODEL_NAMES). Trees/iforest stay
+# replicated always: far below the bytes where sharding pays, and their
+# int-heavy pytrees gain nothing from a gather seam.
+SHARDABLE_BRANCHES: Dict[str, str] = {
+    "bert_text": "bert",
+    "lstm_sequential": "lstm",
+    "graph_neural": "gnn",
+}
+
+
+def _dim_spec(shape: Sequence[int], dim: int, axis_size: int) -> P:
+    """P sharding ``dim`` over ``model`` when divisible, else replicated."""
+    if axis_size <= 1 or not shape or shape[dim] % axis_size:
+        return P()
+    spec = [None] * len(shape)
+    spec[dim] = MODEL_AXIS
+    return P(*spec)
+
+
+def leaf_storage_spec(leaf: Any, axis_size: int) -> P:
+    """Generic storage spec for one serving param leaf: shard the largest
+    dim divisible by the model-axis size, else replicate. The rule the
+    LSTM/GNN branches use — their pytrees are flat w/b dicts with no
+    attention/FFN structure to honor."""
+    shape = np.shape(leaf)
+    if axis_size <= 1 or not shape:
+        return P()
+    for dim in sorted(range(len(shape)), key=lambda d: -shape[d]):
+        if shape[dim] % axis_size == 0 and shape[dim] >= axis_size:
+            return _dim_spec(shape, dim, axis_size)
+    return P()
+
+
+def _dense_storage_specs(p: Dict[str, Any], axis_size: int,
+                         column: bool) -> Dict[str, P]:
+    """Storage specs for one dense layer dict, f32 ``{"w", "b"}`` or
+    weight-only int8 ``{"qw", "scale", "b"}`` (models/quant.py layout).
+    ``column``: split the output feature dim (q/k/v/ffn1) — the bias and
+    the per-output-channel scale split with it; row layers (o/ffn2) split
+    the input dim and keep bias/scale whole."""
+    wkey = "qw" if "qw" in p else "w"
+    wdim = 1 if column else 0
+    specs: Dict[str, P] = {
+        wkey: _dim_spec(np.shape(p[wkey]), wdim, axis_size),
+    }
+    out_split = (column
+                 and specs[wkey] != P())      # output dim actually sharded
+    if "scale" in p:
+        specs["scale"] = (_dim_spec(np.shape(p["scale"]), 0, axis_size)
+                          if out_split else P())
+    specs["b"] = (_dim_spec(np.shape(p["b"]), 0, axis_size)
+                  if out_split else P())
+    return specs
+
+
+def _embedding_storage_spec(table: Any, axis_size: int) -> Any:
+    """Embedding storage specs: rows (vocab/positions) over ``model`` —
+    both the bare f32 table and the quantized ``{"qe", "scale"}`` form
+    (per-row scales shard with their rows)."""
+    if isinstance(table, dict) and "qe" in table:
+        rows_spec = _dim_spec(np.shape(table["qe"]), 0, axis_size)
+        if rows_spec != P():
+            return {"qe": rows_spec,
+                    "scale": _dim_spec(np.shape(table["scale"]), 0,
+                                       axis_size)}
+        # rows indivisible (e.g. vocab 30522 on a 4-way axis): split the
+        # hidden dim instead — per-row scales then stay whole
+        return {"qe": _dim_spec(np.shape(table["qe"]), 1, axis_size),
+                "scale": P()}
+    spec = _dim_spec(np.shape(table), 0, axis_size)
+    if spec == P():
+        spec = _dim_spec(np.shape(table), 1, axis_size)
+    return spec
+
+
+def bert_serving_param_specs(params: Dict[str, Any],
+                             axis_size: int) -> Dict[str, Any]:
+    """Storage-spec pytree for the BERT branch, f32 OR weight-only int8.
+
+    Megatron positions (column: q/k/v/ffn1, row: o/ffn2; embeddings over
+    rows); layer norms and the 2-logit head stay replicated — they are a
+    rounding error in bytes and the head feeds the decision ladder."""
+    ln = {"scale": P(), "bias": P()}
+    rep_dense = lambda p: {k: P() for k in p}                 # noqa: E731
+    return {
+        "word_emb": _embedding_storage_spec(params["word_emb"], axis_size),
+        "pos_emb": _embedding_storage_spec(params["pos_emb"], axis_size),
+        "emb_ln": ln,
+        "layers": [{
+            "q": _dense_storage_specs(layer["q"], axis_size, column=True),
+            "k": _dense_storage_specs(layer["k"], axis_size, column=True),
+            "v": _dense_storage_specs(layer["v"], axis_size, column=True),
+            "o": _dense_storage_specs(layer["o"], axis_size, column=False),
+            "attn_ln": ln,
+            "ffn1": _dense_storage_specs(layer["ffn1"], axis_size,
+                                         column=True),
+            "ffn2": _dense_storage_specs(layer["ffn2"], axis_size,
+                                         column=False),
+            "ffn_ln": ln,
+        } for layer in params["layers"]],
+        "pre_classifier": rep_dense(params["pre_classifier"]),
+        "classifier": rep_dense(params["classifier"]),
+    }
+
+
+def branch_serving_specs(models: Any, axis_size: int,
+                         shard_branches: Sequence[str]) -> Any:
+    """Storage-spec pytree for a full ScoringModels set under a per-branch
+    placement: branches named in ``shard_branches`` (registry names, must
+    be SHARDABLE_BRANCHES members) store sharded over ``model``; everything
+    else — trees, iforest, and any un-named branch — replicates."""
+    for name in shard_branches:
+        if name not in SHARDABLE_BRANCHES:
+            raise ValueError(
+                f"branch {name!r} is not shardable; expected one of "
+                f"{sorted(SHARDABLE_BRANCHES)} (trees/iforest/rules are "
+                f"replicated by design)")
+    rep = lambda tree: jax.tree_util.tree_map(lambda _: P(), tree)  # noqa: E731
+    sharded = set(shard_branches) if axis_size > 1 else set()
+    return type(models)(
+        trees=rep(models.trees),
+        iforest=rep(models.iforest),
+        lstm=(jax.tree_util.tree_map(
+            lambda lf: leaf_storage_spec(lf, axis_size), models.lstm)
+            if "lstm_sequential" in sharded else rep(models.lstm)),
+        gnn=(jax.tree_util.tree_map(
+            lambda lf: leaf_storage_spec(lf, axis_size), models.gnn)
+            if "graph_neural" in sharded else rep(models.gnn)),
+        bert=(bert_serving_param_specs(models.bert, axis_size)
+              if "bert_text" in sharded else rep(models.bert)),
     )
 
 

@@ -18,4 +18,7 @@ from realtime_fraud_detection_tpu.scoring.host_pipeline import (  # noqa: F401
 from realtime_fraud_detection_tpu.scoring.device_pool import (  # noqa: F401
     DevicePool,
 )
+from realtime_fraud_detection_tpu.scoring.mesh_executor import (  # noqa: F401
+    MeshExecutor,
+)
 from realtime_fraud_detection_tpu.scoring.scorer import FraudScorer  # noqa: F401

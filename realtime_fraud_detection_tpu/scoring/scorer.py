@@ -840,8 +840,13 @@ class FraudScorer:
         # rtfd-lint: allow[wall-clock] span diagnostics (host_stats), not scoring control flow
         t_pack = time.perf_counter()
         n = len(records)
-        size = bucket_for(n, BATCH_BUCKETS,
-                          multiple_of=local_mesh_size(self.mesh))
+        # an attached mesh executor (scoring/mesh_executor.py) shards the
+        # batch over ITS data axis, which may differ from this scorer's
+        # own mesh (e.g. a 1-device reference scorer driving a 4x2
+        # executor) — pad to whichever seam the batch will actually cross
+        multiple = (getattr(self._pool, "batch_multiple", None)
+                    or local_mesh_size(self.mesh))
+        size = bucket_for(n, BATCH_BUCKETS, multiple_of=multiple)
         # write-into staging: pad rows replicate row 0, the real validity
         # is the staging mask (same contract as pad_to_bucket)
         padded, mask = self._staging.pad(batch, n, size)

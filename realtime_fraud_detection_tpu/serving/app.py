@@ -104,6 +104,20 @@ class ServingApp:
 
             self.pool = DevicePool(self.scorer,
                                    inflight_depth=sc.inflight_depth)
+        elif self.config.mesh.enabled and self.pool is None:
+            # mesh-sharded branch execution (config.mesh / scoring/
+            # mesh_executor.py): same dispatch/finalize seam as the pool
+            # — the two-phase batcher, QoS masks and hot swap compose
+            # unchanged — but each rotation slot is a data x model MESH
+            # storing the configured branches sharded
+            from realtime_fraud_detection_tpu.scoring import MeshExecutor
+
+            mcfg = self.config.mesh
+            self.pool = MeshExecutor(
+                self.scorer, model_axis=mcfg.model,
+                replicas=mcfg.replicas,
+                inflight_depth=mcfg.inflight_depth,
+                shard_branches=tuple(mcfg.shard_branches))
         # tracing plane (obs/tracing.py): per-transaction flight recorder
         # + /latency/breakdown + /slo. Constructed only when enabled —
         # the scoring path's no-op cost is one `is None` branch per batch.
@@ -602,7 +616,9 @@ class ServingApp:
         payload = self.metrics.summary()
         payload["host_assembly"] = self.scorer.host_stats()
         if self.pool is not None:
-            payload["device_pool"] = self.pool.stats()
+            key = ("mesh" if hasattr(self.pool, "mesh_snapshot")
+                   else "device_pool")
+            payload[key] = self.pool.stats()
         return 200, payload
 
     async def _metrics_prometheus(self, body, query) -> Tuple[int, Any]:
@@ -613,7 +629,14 @@ class ServingApp:
         self.metrics.sync_quant(self.scorer.quant_snapshot())
         self.metrics.sync_microbatch(self.batcher.close_reasons)
         if self.pool is not None:
-            self.metrics.sync_device_pool(self.pool.stats())
+            # a mesh executor mirrors through its own series (geometry,
+            # placement, per-chip bytes); the replicated pool keeps the
+            # device_pool_* family
+            mesh_snap = getattr(self.pool, "mesh_snapshot", None)
+            if mesh_snap is not None:
+                self.metrics.sync_mesh(mesh_snap())
+            else:
+                self.metrics.sync_device_pool(self.pool.stats())
         if self.tracer is not None:
             self.metrics.sync_tracing(self.tracer.snapshot())
         if self.tuning is not None:

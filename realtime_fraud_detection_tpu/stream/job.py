@@ -261,17 +261,22 @@ class StreamJob:
         self._inflight_ids: set = set()
 
     def _inflight_depth(self) -> int:
-        """Run-loop in-flight window: the configured pipeline depth, raised
+        """Run-loop in-flight window: the configured pipeline depth, set
         to the device pool's capacity when one is attached — a window
-        smaller than devices x depth would leave replicas starved. With
-        the tuning plane attached, its online-tuned depth replaces the
+        smaller than devices x depth would leave replicas starved, and a
+        window LARGER than capacity would deadlock the single-threaded
+        run loop (the executor's dispatch blocks for a slot that only
+        this loop's own finalize can free; a 1-replica MeshExecutor at
+        depth 2 under a configured depth 3 hit exactly this). With the
+        tuning plane attached, its online-tuned depth replaces the
         configured one (re-read every loop iteration, so a tuner move
-        takes effect one batch later); the pool floor still applies."""
+        takes effect one batch later); an attached pool's capacity still
+        overrides — it IS the hardware window."""
         depth = max(1, self.config.pipeline_depth)
         if self.tuning is not None:
             depth = max(1, self.tuning.recommended_inflight_depth())
         if self.pool is not None:
-            depth = max(depth, self.pool.total_slots())
+            depth = self.pool.total_slots()
         return depth
 
     # ----------------------------------------------------------------- steps

@@ -78,11 +78,53 @@ class EnsembleConfig:
     cache_max_entries: int = 1000
 
 
+# Branches whose params may take the sharded placement on the serving
+# mesh (scoring/mesh_executor.py; parallel/layouts.SHARDABLE_BRANCHES maps
+# these onto ScoringModels fields — a test pins the two in sync). Trees /
+# iforest / rules are replicated by design.
+MESH_SHARDABLE_BRANCHES = ("bert_text", "lstm_sequential", "graph_neural")
+
+
 @dataclass
 class MeshSettings:
+    """Mesh geometry: the (data, model, seq) axes for core/mesh.py AND the
+    GSPMD serving executor's knobs (scoring/mesh_executor.py).
+
+    ``enabled`` opts a serving/stream deployment into mesh-sharded branch
+    execution: ``replicas`` independent ``data x model`` meshes in
+    round-robin rotation (pool x mesh — replicate the MESH, not the
+    chip), each storing the ``shard_branches`` params sharded over
+    ``model`` (per-chip HBM ~1/model) while the microbatch shards over
+    ``data``. Off by default — the replicated DevicePool remains the
+    baseline plane; ``rtfd mesh-drill`` gates the sharded path's
+    bit-equality contract.
+    """
+
     data: int | None = None
     model: int = 1
     seq: int = 1
+    # serving executor (scoring/mesh_executor.py)
+    enabled: bool = False
+    replicas: int = 1
+    inflight_depth: int = 2
+    shard_branches: List[str] = field(
+        default_factory=lambda: ["bert_text"])
+
+    def validate(self) -> None:
+        if self.model < 1 or self.seq < 1:
+            raise ValueError(
+                f"mesh axes must be >= 1, got model={self.model} "
+                f"seq={self.seq}")
+        if self.replicas < 1 or self.inflight_depth < 1:
+            raise ValueError(
+                "mesh.replicas and mesh.inflight_depth must be >= 1")
+        bad = [b for b in self.shard_branches
+               if b not in MESH_SHARDABLE_BRANCHES]
+        if bad:
+            raise ValueError(
+                f"mesh.shard_branches {bad} not shardable; valid: "
+                f"{list(MESH_SHARDABLE_BRANCHES)} (trees/iforest/rules "
+                f"are replicated by design)")
 
 
 @dataclass
@@ -926,6 +968,7 @@ class Config:
                 "review_threshold <= decline_threshold <= 1, got "
                 f"monitor={e.monitor_threshold} review={e.review_threshold} "
                 f"decline={e.decline_threshold}")
+        self.mesh.validate()
         self.qos.validate()
         self.feedback.validate()
         self.tracing.validate()

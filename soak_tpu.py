@@ -88,6 +88,36 @@ def run() -> None:
     # weights host-side once per scorer build, before any timed window.
     quant = "--quant" in sys.argv
     out["quantized"] = quant
+    # --mesh: every config scores through a MeshExecutor (GSPMD
+    # data x model over all addressable chips, BERT branch stored sharded
+    # over ``model`` — the rtfd mesh-drill gated path) instead of the
+    # single-device program, so one relay window captures the mesh e2e
+    # rate next to the f32/--quant ones. Composes with --quant: the
+    # sharded storage carries the int8 form for free.
+    mesh_on = "--mesh" in sys.argv
+    mesh_model_axis = 0
+    if mesh_on:
+        n_dev = len(jax.devices())
+        mesh_model_axis = 2 if n_dev > 1 and n_dev % 2 == 0 else 1
+    out["mesh"] = ({"model_axis": mesh_model_axis} if mesh_on else None)
+
+    def attach_mesh(scorer, depth):
+        if not mesh_on:
+            return
+        from realtime_fraud_detection_tpu.scoring import MeshExecutor
+
+        # the executor's slot count BECOMES the job's in-flight window
+        # (StreamJob._inflight_depth follows an attached pool's
+        # total_slots), so each sweep config's depth knob must flow into
+        # the executor or the d2-vs-d3 comparison would silently measure
+        # one window twice. A single-threaded dispatcher must also never
+        # out-dispatch the slots — it would deadlock waiting for a
+        # completion only it can perform — hence depth is passed, never
+        # hardcoded below a caller's hand-rolled loop depth.
+        MeshExecutor(scorer, model_axis=mesh_model_axis,
+                     inflight_depth=depth,
+                     shard_branches=(("bert_text",)
+                                     if mesh_model_axis > 1 else ()))
     if smoke:
         # CPU smoke: tiny arch + one config — proves the measurement path
         # end-to-end so a bug can never burn a live relay window
@@ -110,7 +140,7 @@ def run() -> None:
     for max_batch, depth, bf16, explain in sweep:
         label = (f"b{max_batch}-d{depth}"
                  f"{'-bf16' if bf16 else ''}{'-explain' if explain else ''}"
-                 f"{'-quant' if quant else ''}")
+                 f"{'-quant' if quant else ''}{'-mesh' if mesh_on else ''}")
         log(f"config {label}: building scorer")
         cfg = Config()
         cfg.ensemble.enable_explanation = explain
@@ -124,6 +154,7 @@ def run() -> None:
             config=cfg,
             scorer_config=ScorerConfig(text_len=64, transfer_bf16=bf16),
             bert_config=bert_config)
+        attach_mesh(scorer, depth)
         scorer.seed_profiles(gen.users.profiles(), gen.merchants.profiles())
         broker = InMemoryBroker()
         job = StreamJob(broker, scorer,
@@ -172,6 +203,7 @@ def run() -> None:
         cfg.quant = QuantSettings.full()
     scorer = FraudScorer(config=cfg, scorer_config=ScorerConfig(text_len=64),
                          bert_config=bert_config)
+    attach_mesh(scorer, 4)   # >= the hand-rolled depth-3 loop below
     scorer.seed_profiles(gen.users.profiles(), gen.merchants.profiles())
     batch_recs = [gen.generate_batch(64 if smoke else 512)
                   for _ in range(6 if smoke else 40)]
@@ -207,8 +239,9 @@ def run() -> None:
     best = max(out["configs"], key=lambda e: e["txn_per_s"])
     out["best"] = best
     here = os.path.dirname(os.path.abspath(__file__))
+    suffix = f"{'_quant' if quant else ''}{'_mesh' if mesh_on else ''}"
     fname = ("MEASUREMENTS_smoke.json" if smoke
-             else ("MEASUREMENTS_r05_onchip_quant.json" if quant
+             else (f"MEASUREMENTS_r05_onchip{suffix}.json" if suffix
                    else "MEASUREMENTS_r05_onchip.json"))
     path = (os.path.join("/tmp", fname) if smoke
             else os.path.join(here, fname))

@@ -142,20 +142,68 @@ def main() -> int:
         models = models.replace(
             bert=quantize_bert_params(jax.device_get(models.bert)))
         kernel = "gemm"
-    models = jax.device_put(models)
+    # --mesh: sweep the GSPMD-SHARDED fused program — batch over ``data``,
+    # BERT params STORED over ``model`` and re-gathered at the use seam
+    # (scoring/mesh_executor.py semantics, the rtfd mesh-drill gated
+    # path) — so one relay window captures mesh numbers next to the f32
+    # and --quant sweeps (ROADMAP consolidated-capture item).
+    mesh = None
+    if "--mesh" in sys.argv and len(jax.devices()) > 1:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from realtime_fraud_detection_tpu.core.mesh import (
+            MeshConfig,
+            build_mesh,
+        )
+        from realtime_fraud_detection_tpu.parallel.layouts import (
+            batch_shardings,
+            branch_serving_specs,
+            tree_specs_to_shardings,
+        )
+
+        model_axis = 2 if len(jax.devices()) % 2 == 0 else 1
+        mesh = build_mesh(MeshConfig(model=model_axis))
+        _emit(stage="mesh", data_axis=int(mesh.shape["data"]),
+              model_axis=model_axis, shard_branches=["bert_text"])
+        models = jax.device_put(models, tree_specs_to_shardings(
+            mesh, branch_serving_specs(models, model_axis,
+                                       ("bert_text",))))
+        _rep = NamedSharding(mesh, P())
+
+    def _put(x):
+        """Stage a host array: sharded over the mesh data axis under
+        --mesh, plain default-device put otherwise."""
+        if mesh is None:
+            return jax.device_put(x)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return jax.device_put(x, NamedSharding(
+            mesh, P("data", *([None] * (np.ndim(x) - 1)))))
+
+    if mesh is None:
+        models = jax.device_put(models)
+        fused = jax.jit(lambda m, b, p, v: score_fused(
+            m, b, p, v, bert_config=bert_config, with_model_preds=False,
+            tree_kernel=kernel, iforest_kernel=kernel))
+    else:
+        fused = jax.jit(lambda m, b, p, v: score_fused(
+            m.replace(bert=jax.tree_util.tree_map(
+                lambda x: jax.lax.with_sharding_constraint(x, _rep),
+                m.bert)),
+            b, p, v, bert_config=bert_config, with_model_preds=False,
+            tree_kernel=kernel, iforest_kernel=kernel))
     params = EnsembleParams.from_config(Config(), list(MODEL_NAMES))
     valid = jnp.ones((len(MODEL_NAMES),), bool)
-    fused = jax.jit(lambda m, b, p, v: score_fused(
-        m, b, p, v, bert_config=bert_config, with_model_preds=False,
-        tree_kernel=kernel, iforest_kernel=kernel))
     for bucket in (64, 128, 256, 512, 1024):
         host_batch = make_example_batch(
             bucket, sc, rng=np.random.default_rng(bucket))
         # variants built from the HOST copy (a np.asarray on the device
         # copy would be a d2h pull — the tunnel sync-mode trap)
-        feats = [jax.device_put(host_batch.features + np.float32(j))
+        feats = [_put(host_batch.features + np.float32(j))
                  for j in range(8)]
-        batch = jax.device_put(host_batch)
+        batch = (jax.device_put(host_batch) if mesh is None
+                 else jax.device_put(host_batch,
+                                     batch_shardings(mesh, host_batch)))
         t = _time_blocked(
             lambda i: fused(models, batch.replace(features=feats[i % 8]),
                             params, valid), 40)
@@ -173,14 +221,22 @@ def main() -> int:
     from realtime_fraud_detection_tpu.models.trees import tree_ensemble_predict
 
     host_batch = make_example_batch(256, sc, rng=np.random.default_rng(1))
-    feats = [jax.device_put(host_batch.features + np.float32(j))
+    feats = [_put(host_batch.features + np.float32(j))
              for j in range(8)]
-    hists = [jax.device_put(host_batch.history + np.float32(j))
+    hists = [_put(host_batch.history + np.float32(j))
              for j in range(8)]
-    toks = [jax.device_put(((host_batch.token_ids + j)
-                            % bert_config.vocab_size).astype(np.int32))
+    toks = [_put(((host_batch.token_ids + j)
+                  % bert_config.vocab_size).astype(np.int32))
             for j in range(8)]
-    batch = jax.device_put(host_batch)
+    if mesh is None:
+        batch = jax.device_put(host_batch)
+    else:
+        from realtime_fraud_detection_tpu.parallel.layouts import (
+            batch_shardings,
+        )
+
+        batch = jax.device_put(host_batch,
+                               batch_shardings(mesh, host_batch))
     jtree = jax.jit(lambda f: tree_ensemble_predict(models.trees, f,
                                                     kernel=kernel))
     jifo = jax.jit(lambda f: iforest_predict(models.iforest, f,
