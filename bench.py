@@ -225,6 +225,15 @@ def _compact_summary(result: dict) -> dict:
             "handoff_replayed": (sh.get("handoff") or {}).get("replayed"),
         } if (sh := result.get("shard_scaling") or {})
             and not sh.get("error") else None),
+        "elastic_scaling": ({
+            "aggregate_txn_per_s": el.get("aggregate_txn_per_s"),
+            "scaling_vs_min": el.get("scaling_vs_min"),
+            "scaling_efficiency": el.get("scaling_efficiency"),
+            "kill_rebalance_pause_s": (el.get("kill_run")
+                                       or {}).get("rebalance_pause_s"),
+            "kill_replayed": (el.get("kill_run") or {}).get("replayed"),
+        } if (el := result.get("elastic_scaling") or {})
+            and not el.get("error") else None),
         "quantization": ({
             "bytes_ratio": (qz.get("param_bytes") or {}).get("ratio"),
             "bert_quant_us_per_txn": ((qz.get("branches") or {}).get(
@@ -268,7 +277,7 @@ def _compact_summary(result: dict) -> dict:
         for victim in ("configs_txn_per_s", "operating_point", "quality",
                        "host_assembly", "mesh_scaling", "pool_scaling",
                        "autotune", "chaos",
-                       "shard_scaling", "quantization",
+                       "shard_scaling", "elastic_scaling", "quantization",
                        "latest_committed_tpu_capture",
                        "text_encoder", "error"):
             if compact.pop(victim, None) is not None:
@@ -1034,6 +1043,22 @@ def run_bench() -> None:
                 "error": f"{type(e).__name__}: {e}"[:200]}
         _log(f'shard-scaling stage done: '
              f'{ {k: v for k, v in (result.get("shard_scaling") or {}).items() if not isinstance(v, dict)} }')
+
+    # ---------------------------------------------- elastic-scaling stage
+    # Process-boundary cluster (cluster/procfleet.py): REAL aggregate
+    # txn/s at 2/4/8 OS worker processes over the TCP netbroker +
+    # network handoff, plus a SIGKILL run's rebalance pause and replay
+    # depth. Workers are forced onto the CPU platform (host arithmetic
+    # only), so this is safe on any box including a tunneled TPU
+    # session — the subprocesses never touch the tunnel.
+    if remaining() > 90:
+        try:
+            _elastic_scaling_stage(result, snapshot)
+        except Exception as e:  # noqa: BLE001
+            result["elastic_scaling"] = {
+                "error": f"{type(e).__name__}: {e}"[:200]}
+        _log(f'elastic-scaling stage done: '
+             f'{ {k: v for k, v in (result.get("elastic_scaling") or {}).items() if not isinstance(v, dict)} }')
 
     # ------------------------------------------------- quantization stage
     # Quantized scoring plane (models/quant.py): per-branch f32-vs-quant
@@ -1826,6 +1851,24 @@ def _shard_scaling_stage(result: dict, snapshot) -> None:
 
     result["shard_scaling"] = run_shard_scaling()
     snapshot("shard_scaling")
+
+
+def _elastic_scaling_stage(result: dict, snapshot) -> None:
+    """Process-boundary cluster (ISSUE 12 bench satellite): real
+    aggregate txn/s of the ``ProcessFleet`` at pinned 2/4/8 OS worker
+    processes over the TCP netbroker + network handoff store, plus a
+    SIGKILL run's rebalance pause and committed-gap replay depth. The
+    per-batch service-cost model is fixed, so the ratio prices the
+    orchestration overhead (TCP round trips, partition-scoped
+    consumption, commit + checkpoint traffic) on top of
+    perfectly-parallel modeled compute. The pass/fail bar lives in
+    ``rtfd elastic-drill`` and the tier-1 smoke."""
+    from realtime_fraud_detection_tpu.cluster.elastic_drill import (
+        run_elastic_scaling,
+    )
+
+    result["elastic_scaling"] = run_elastic_scaling()
+    snapshot("elastic_scaling")
 
 
 def _quantization_stage(result: dict, models, sc, bert_config,

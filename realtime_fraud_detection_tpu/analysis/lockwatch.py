@@ -45,10 +45,10 @@ _REAL_CONDITION = threading.Condition
 
 PACKAGE_MARKER = "realtime_fraud_detection_tpu"
 
-# the eight deterministic drills the watcher is validated against
+# the nine deterministic drills the watcher is validated against
 LOCKWATCH_DRILLS = ("qos-drill", "trace-drill", "autotune-drill",
                     "feedback-drill", "pool-drill", "chaos-drill",
-                    "shard-drill", "mesh-drill")
+                    "shard-drill", "mesh-drill", "elastic-drill")
 
 
 class LockWatcher:
@@ -463,7 +463,7 @@ def run_drill_watched(drill: str, fast: bool = True,
                     ShardDrillConfig.fast() if fast else ShardDrillConfig(),
                     replay_check=False)
                 passed = bool(run_shard_drill(cfg)["passed"])
-            else:   # mesh-drill
+            elif drill == "mesh-drill":
                 import dataclasses
 
                 from realtime_fraud_detection_tpu.scoring.mesh_drill import (
@@ -478,4 +478,22 @@ def run_drill_watched(drill: str, fast: bool = True,
                     MeshDrillConfig.fast() if fast else MeshDrillConfig(),
                     replay_check=False)
                 passed = bool(run_mesh_drill(cfg)["passed"])
+            else:   # elastic-drill
+                import dataclasses
+
+                from realtime_fraud_detection_tpu.cluster.elastic_drill import (
+                    ElasticDrillConfig,
+                    run_elastic_drill,
+                )
+
+                # single pass (the fresh-run digest is the drill's own
+                # acceptance). The watcher instruments THIS process —
+                # the coordinator, broker server, and handoff server
+                # threads; the worker subprocesses run their own
+                # interpreters and are covered by the drill's checks.
+                cfg = dataclasses.replace(
+                    ElasticDrillConfig.fast() if fast
+                    else ElasticDrillConfig(),
+                    replay_check=False)
+                passed = bool(run_elastic_drill(cfg)["passed"])
     return {"drill": drill, "drill_passed": passed, "lockwatch": w.report()}

@@ -242,23 +242,37 @@ class SlowDevice:
 
 
 class WorkerKill:
-    """Kill a partition-parallel fleet worker (cluster/fleet.WorkerFleet)
-    with process-death semantics: live state and in-flight batches are
-    gone, no graceful flush — the fleet's checkpointed-handoff path
-    (snapshot restore + committed-gap state replay on the survivors) is
-    what recovers. One-shot like :class:`ConsumerMemberKill`: ``end`` is
-    a no-op; the fleet heals by rebalancing, not by resurrection.
+    """Kill a partition-parallel fleet worker with process-death
+    semantics: live state and in-flight batches are gone, no graceful
+    flush — the fleet's checkpointed-handoff path (snapshot restore +
+    committed-gap state replay on the survivors) is what recovers.
+    One-shot like :class:`ConsumerMemberKill`: ``end`` is a no-op; the
+    fleet heals by rebalancing, not by resurrection.
 
-    ``target`` is anything with ``kill_worker(worker_id, now=...)`` — the
-    WorkerFleet, or a stub in tests."""
+    ``target`` is anything with ``kill_worker(worker_id, now=...)``:
+
+    - ``cluster.fleet.WorkerFleet`` — the in-process fleet (shard-drill):
+      a SIMULATED death (the thread's state is dropped cooperatively);
+    - ``cluster.procfleet.ProcessFleet`` — the ESCALATED form the
+      elastic drill uses: ``kill_worker`` sends a real ``SIGKILL`` to the
+      worker's OS process, so the fault is delivered by the kernel, not
+      by this injector's goodwill. ``worker_id="busiest"`` resolves at
+      kill time to the worker owning the most partitions (deterministic
+      tie-break) — the kill must move real state, not hit an idle
+      member;
+    - or a stub in tests.
+
+    ``last_result`` keeps the target's kill report (returncode, replay
+    depth) for the drill's verdict."""
 
     def __init__(self, target: Any, worker_id: str):
         self.target = target
         self.worker_id = worker_id
         self.killed = 0
+        self.last_result: Optional[Dict[str, Any]] = None
 
     def begin(self, now: float) -> None:
-        self.target.kill_worker(self.worker_id, now=now)
+        self.last_result = self.target.kill_worker(self.worker_id, now=now)
         self.killed += 1
 
     def end(self, now: float) -> None:

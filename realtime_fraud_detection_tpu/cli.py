@@ -234,6 +234,25 @@ def cmd_run_job(args: argparse.Namespace) -> int:
                 job_id, step, str(path),
                 duration_ms=(time.perf_counter() - t_ck) * 1e3)
 
+    # graceful shutdown (robustness satellite, ISSUE 12): SIGTERM/SIGINT
+    # drain the in-flight microbatches, commit their offsets, and write a
+    # final checkpoint before exit — a terminated job loses NOTHING to
+    # replay-on-restart; only SIGKILL (no handler possible) replays the
+    # uncommitted tail
+    import signal as _signal
+
+    stop_sig: Dict[str, Any] = {"name": None}
+
+    def _graceful(signum, frame):  # noqa: ANN001 - signal contract
+        stop_sig["name"] = _signal.Signals(signum).name
+        job.request_stop()
+
+    try:
+        _signal.signal(_signal.SIGTERM, _graceful)
+        _signal.signal(_signal.SIGINT, _graceful)
+    except ValueError:
+        pass                      # not the main thread (embedded/test use)
+
     t0 = time.perf_counter()
     produced = scored = step = 0
     if ckpt is not None and ckpt.latest_step() is not None:
@@ -251,13 +270,15 @@ def cmd_run_job(args: argparse.Namespace) -> int:
         if args.count == 0:
             # consume-only: an external simulator feeds the broker; run in
             # checkpointed slices until --duration elapses (0 = forever)
-            while args.duration <= 0 or time.perf_counter() - t0 < args.duration:
+            while (args.duration <= 0
+                   or time.perf_counter() - t0 < args.duration) \
+                    and not job.stop_requested:
                 scored += job.run_for(
                     min(10.0, args.duration - (time.perf_counter() - t0))
                     if args.duration > 0 else 10.0)
                 step += 1
                 _checkpoint_step(step)
-        while produced < args.count:
+        while produced < args.count and not job.stop_requested:
             chunk = min(args.count - produced, 10_000)
             records = gen.generate_batch(chunk)
             broker.produce_batch(T.TRANSACTIONS, records,
@@ -280,6 +301,16 @@ def cmd_run_job(args: argparse.Namespace) -> int:
         raise
     if job.analytics is not None:
         job.analytics.flush()
+    if stop_sig["name"] is not None:
+        # the run loops drained + committed before returning; the final
+        # checkpoint pins (state, offsets) at the drained point so resume
+        # replays NOTHING (regression-pinned in tests/test_elastic.py)
+        step += 1
+        _checkpoint_step(step)
+        print(f"graceful shutdown on {stop_sig['name']}: in-flight "
+              f"drained, offsets committed"
+              + (f", final checkpoint step {step}"
+                 if ckpt is not None else ""), file=sys.stderr)
     dt = time.perf_counter() - t0
     if metadata is not None:
         metadata.set_job_status(job_id, "FINISHED")
@@ -290,6 +321,8 @@ def cmd_run_job(args: argparse.Namespace) -> int:
         "wall_s": round(dt, 3),
         "txn_per_s": round(scored / dt, 1),
         "counters": job.counters,
+        **({"stopped_by": stop_sig["name"]}
+           if stop_sig["name"] is not None else {}),
     }
     if feedback_plane is not None:
         snap = feedback_plane.snapshot()
@@ -689,6 +722,20 @@ def cmd_broker(args: argparse.Namespace) -> int:
     finally:
         server.stop()
     return 0
+
+
+def cmd_cluster_worker(args: argparse.Namespace) -> int:
+    """One partition-scoped fleet worker PROCESS (cluster/procfleet.py):
+    spawned by the elastic coordinator (``ProcessFleet`` — the elastic
+    drill, the bench elastic_scaling stage) with a JSON spec naming the
+    broker, the handoff server, and this worker's identity. Consumes its
+    assigned partitions over the TCP netbroker, checkpoints into the
+    network handoff store, drains gracefully on SIGTERM/shutdown, and
+    reports state digests in its bye event. Not normally invoked by
+    hand."""
+    from realtime_fraud_detection_tpu.cluster.procfleet import worker_main
+
+    return worker_main(json.loads(args.spec))
 
 
 def cmd_state_server(args: argparse.Namespace) -> int:
@@ -1266,9 +1313,42 @@ def cmd_shard_drill(args: argparse.Namespace) -> int:
     return 0 if summary["passed"] else 1
 
 
+def cmd_elastic_drill(args: argparse.Namespace) -> int:
+    """Deterministic elastic-cluster drill (cluster/elastic_drill.py): a
+    seeded diurnal-ramp timeline over a 10M-user id space scored by a
+    fleet of REAL OS worker processes over the TCP netbroker, with the
+    network-served handoff store, a real SIGKILL at the busiest worker
+    mid-peak, and the autoscale controller growing the fleet ahead of the
+    forecast peak and draining it after. Pins effectively-once scoring
+    (zero lost / conflicting-scored, gap-free offsets, state + scores
+    equal to a single-process oracle), returncode -9 from the kill,
+    bounded consistent-hash movement, and a digest-identical second
+    fresh run (host-timing fields excluded). Prints the full summary,
+    then a compact (<2 KB) verdict as the FINAL stdout line (bench.py
+    convention). Exit 1 unless every check passed. Pure host arithmetic
+    in the workers — no device needed, but REAL processes, REAL TCP,
+    REAL signals."""
+    import dataclasses as _dc
+
+    from realtime_fraud_detection_tpu.cluster.elastic_drill import (
+        ElasticDrillConfig,
+        compact_elastic_summary,
+        run_elastic_drill,
+    )
+
+    cfg = ElasticDrillConfig.fast() if args.fast else ElasticDrillConfig()
+    cfg = _dc.replace(cfg, seed=args.seed,
+                      replay_check=not args.no_replay)
+    summary = run_elastic_drill(cfg)
+    print(json.dumps(summary), flush=True)
+    print(json.dumps(compact_elastic_summary(summary),
+                     separators=(",", ":")), flush=True)
+    return 0 if summary["passed"] else 1
+
+
 def cmd_lint(args: argparse.Namespace) -> int:
     """Run the repo-native invariant checker (analysis/lint.py) — or, with
-    --lockwatch, the dynamic lock-order watcher under all eight
+    --lockwatch, the dynamic lock-order watcher under all nine
     deterministic drills (analysis/lockwatch.py). Exit 0 only when clean.
 
     The static rules (wall-clock, d2h, metrics, lock-order, determinism,
@@ -1646,6 +1726,16 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--poll-interval", type=float, default=1.0)
     sp.set_defaults(fn=cmd_alert_router)
 
+    sp = sub.add_parser("cluster-worker",
+                        help="run one partition-scoped fleet worker "
+                             "process (spawned by the elastic cluster "
+                             "coordinator)")
+    sp.add_argument("--spec", required=True,
+                    help="JSON worker spec from the coordinator "
+                         "(broker/handoff addresses, worker id, group, "
+                         "partition count, batch/cost knobs)")
+    sp.set_defaults(fn=cmd_cluster_worker)
+
     sp = sub.add_parser("state-server",
                         help="run the shared state server (Redis protocol)")
     sp.add_argument("--host", default="0.0.0.0")
@@ -1807,6 +1897,19 @@ def build_parser() -> argparse.ArgumentParser:
                     help="skip the second bit-identical replay run")
     sp.set_defaults(fn=cmd_shard_drill)
 
+    sp = sub.add_parser("elastic-drill",
+                        help="deterministic elastic-cluster drill: >= 8 "
+                             "real OS worker processes over the TCP "
+                             "netbroker, network handoff, autoscale "
+                             "ahead of a diurnal peak, real SIGKILL "
+                             "mid-peak, oracle state equality")
+    sp.add_argument("--fast", action="store_true",
+                    help="tier-1 sizes (the CI smoke configuration)")
+    sp.add_argument("--seed", type=int, default=7)
+    sp.add_argument("--no-replay", action="store_true",
+                    help="skip the second fresh determinism run")
+    sp.set_defaults(fn=cmd_elastic_drill)
+
     sp = sub.add_parser("lint",
                         help="repo-native invariant checker (static rules "
                              "+ --lockwatch dynamic lock-order watcher)")
@@ -1815,7 +1918,7 @@ def build_parser() -> argparse.ArgumentParser:
                          "+ bench.py)")
     sp.add_argument("--format", choices=("text", "json"), default="text")
     sp.add_argument("--lockwatch", action="store_true",
-                    help="run the eight deterministic drills under the "
+                    help="run the nine deterministic drills under the "
                          "instrumented lock watcher instead of the static "
                          "rules")
     sp.add_argument("--lockwatch-run", default="",

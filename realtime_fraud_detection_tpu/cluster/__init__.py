@@ -15,7 +15,20 @@ broker PARTITION the unit of both consumption and state ownership:
   (``WorkerFleet`` / ``HandoffStore``);
 - ``cluster.drill`` — ``rtfd shard-drill``, the deterministic acceptance
   artifact (1M-user population, mid-stream worker kill, zero lost /
-  double-scored, oracle state equality, bit-identical replay).
+  double-scored, oracle state equality, bit-identical replay);
+- ``cluster.handoff`` — the network-served handoff store (TCP server +
+  client, crash-safe atomic blobs, sha256-verified restore, offset-epoch
+  zombie fencing) that survives any worker process's death;
+- ``cluster.autoscale`` — the elastic controller feeding the tuning
+  plane's arrival forecaster into target worker count (lead horizon,
+  asymmetric hysteresis, deterministic decision ledger);
+- ``cluster.procfleet`` — the fleet across the PROCESS boundary: workers
+  as spawned OS processes in one consumer group over the TCP netbroker,
+  two-phase rebalances, graceful drain, real-SIGKILL recovery;
+- ``cluster.elastic_drill`` — ``rtfd elastic-drill``, the acceptance
+  artifact for all of the above (10M-user id space, >= 8 OS processes,
+  SIGKILL mid-peak, autoscale ahead of the diurnal ramp, deterministic
+  verdict).
 """
 
 from realtime_fraud_detection_tpu.cluster.hashring import (
@@ -33,6 +46,14 @@ from realtime_fraud_detection_tpu.cluster.fleet import (
     HandoffStore,
     WorkerFleet,
 )
+from realtime_fraud_detection_tpu.cluster.handoff import (
+    FencedEpochError,
+    HandoffClient,
+    HandoffServer,
+)
+from realtime_fraud_detection_tpu.cluster.autoscale import (
+    AutoscaleController,
+)
 
 __all__ = [
     "HashRing",
@@ -44,4 +65,8 @@ __all__ = [
     "ClusterWorker",
     "HandoffStore",
     "WorkerFleet",
+    "HandoffServer",
+    "HandoffClient",
+    "FencedEpochError",
+    "AutoscaleController",
 ]

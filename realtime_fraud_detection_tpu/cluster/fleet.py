@@ -59,9 +59,11 @@ class HandoffStore:
     """Shared snapshot ledger: partition → (committed offset, state blob).
 
     The durable rendezvous between a dying worker's past checkpoints and
-    its partitions' inheritors. In-process it is a locked dict; the blob
-    format (``PartitionState.snapshot_bytes``) is already what a
-    networked object store would hold.
+    its partitions' inheritors. In-process it is a locked dict; the
+    network-served form — same ``put``/``get`` surface, crash-safe
+    atomic blobs, sha256-verified restore, zombie fencing — is
+    ``cluster.handoff.HandoffServer``/``HandoffClient`` (the process-mode
+    fleet's store; a ``ClusterWorker`` takes either interchangeably).
     """
 
     def __init__(self) -> None:
@@ -91,7 +93,7 @@ class ClusterWorker:
                  group_id: str, topic: str = T.TRANSACTIONS,
                  clock: Optional[Callable[[], float]] = None,
                  max_batch: int = 128, max_delay_ms: float = 20.0,
-                 checkpoint_every: int = 8):
+                 checkpoint_every: int = 8, autotune: Any = None):
         self.worker_id = worker_id
         self.broker = broker
         self.scorer = scorer
@@ -104,16 +106,20 @@ class ClusterWorker:
         self.job = StreamJob(broker, scorer, JobConfig(
             group_id=group_id, max_batch=max_batch,
             max_delay_ms=max_delay_ms, emit_features=False,
-            emit_enriched=False, transactions_topic=topic))
+            emit_enriched=False, transactions_topic=topic,
+            autotune=autotune))
         # partition-scoped consumer + (virtual-clock capable) assembler
-        # replace the job's defaults — the drill idiom every plane uses
+        # replace the job's defaults — the drill idiom every plane uses.
+        # The job's tuning plane (if any) stays attached as the new
+        # assembler's close controller, so a process-mode worker's batch
+        # closes are arrival-aware and its in-flight depth tuner-driven.
         self.consumer = broker.consumer([topic], group_id,
                                         partitions={topic: []})
         self.job.consumer = self.consumer
         kw = {"clock": clock} if clock is not None else {}
         self.assembler = MicrobatchAssembler(
             self.consumer, max_batch=max_batch,
-            max_delay_ms=max_delay_ms, **kw)
+            max_delay_ms=max_delay_ms, controller=self.job.tuning, **kw)
         self.job.assembler = self.assembler
         # virtual in-flight window (ctx, done_time), managed by the drive
         # loop; busy_until models the worker's serial compute resource

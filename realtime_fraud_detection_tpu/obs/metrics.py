@@ -590,6 +590,35 @@ class MetricsCollector:
             "Keys (partition moves x key density) the consistent-hash "
             "serving router re-routed across membership changes")
         self._cluster_seen: Dict[str, float] = {}
+        # elastic process fleet (cluster/autoscale.py + handoff.py):
+        # forecast-driven target worker count, scale events, and the
+        # network handoff server's checkpoint/restore/torn-blob ledger —
+        # mirrored from AutoscaleController.snapshot() (+ the fleet's
+        # HandoffClient.stats()) by sync_autoscale at exposition time
+        # (honest counter deltas, same discipline as every sync_* mirror)
+        self.autoscale_target_workers = r.gauge(
+            "autoscale_target_workers",
+            "Worker-count target the autoscale controller currently "
+            "wants (forecast lead x headroom / per-worker capacity)")
+        self.autoscale_forecast_rate = r.gauge(
+            "autoscale_forecast_rate",
+            "Arrival-rate estimate (txn/s) behind the current target")
+        self.autoscale_events = r.counter(
+            "autoscale_events_total",
+            "Autoscale target changes by direction (up = spawn + restore "
+            "+ replay, down = graceful drain)", ("direction",))
+        self.handoff_server_checkpoints = r.counter(
+            "handoff_server_checkpoints_total",
+            "Partition snapshots committed to the network handoff store "
+            "(temp->fsync->rename, sha256-stamped)")
+        self.handoff_server_restores = r.counter(
+            "handoff_server_restores_total",
+            "Verified snapshot restores served to partition inheritors")
+        self.handoff_server_torn_blobs = r.counter(
+            "handoff_server_torn_blobs_total",
+            "Checkpoint blobs that failed sha256 verification on restore "
+            "(the previous checkpoint was served instead)")
+        self._autoscale_seen: Dict[str, float] = {}
         # mesh-sharded scoring plane (scoring/mesh_executor.py): mesh
         # geometry, per-branch placement as exhaustive 0/1 gauges (a
         # placement flip reads as a transition, not a new series — the
@@ -925,6 +954,39 @@ class MetricsCollector:
             if delta > 0:
                 self.cluster_router_moved_keys.inc(delta)
             self._cluster_seen["router_moved"] = total
+
+    def sync_autoscale(self, snapshot: Mapping[str, Any]) -> None:
+        """Mirror an ``cluster.autoscale.AutoscaleController.snapshot()``
+        — optionally carrying a ``handoff_server`` stats block
+        (``HandoffServer.stats()`` / ``HandoffClient.stats()``) — into
+        the autoscale_* / handoff_server_* series. Called at exposition
+        time; cumulative quantities mirror as counter DELTAS against
+        last-seen values (never a negative increment), so a stream-side
+        coordinator and a serving app syncing the same snapshot render
+        IDENTICAL series."""
+        self.autoscale_target_workers.set(
+            float(snapshot.get("target_workers", 0)))
+        self.autoscale_forecast_rate.set(
+            float(snapshot.get("forecast_rate", 0.0)))
+        for direction in ("up", "down"):
+            total = float((snapshot.get("events") or {}).get(direction, 0))
+            key = f"events:{direction}"
+            delta = total - self._autoscale_seen.get(key, 0.0)
+            if delta > 0:
+                self.autoscale_events.inc(delta, direction=direction)
+            self._autoscale_seen[key] = total
+        hs = snapshot.get("handoff_server") or {}
+        for field, counter in (
+                ("checkpoints_total", self.handoff_server_checkpoints),
+                ("restores_total", self.handoff_server_restores),
+                ("torn_blobs_total", self.handoff_server_torn_blobs)):
+            if field not in hs:
+                continue
+            total = float(hs.get(field, 0))
+            delta = total - self._autoscale_seen.get(field, 0.0)
+            if delta > 0:
+                counter.inc(delta)
+            self._autoscale_seen[field] = total
 
     # ------------------------------------------------------------- recording
     def record_prediction(self, decision: str, fraud_score: float,

@@ -6,6 +6,10 @@ from realtime_fraud_detection_tpu.serving.httpd import (
     HttpError,
     HttpServer,
 )
+from realtime_fraud_detection_tpu.serving.ingress_client import (
+    NoShardAvailableError,
+    ShardIngressClient,
+)
 from realtime_fraud_detection_tpu.serving.validation import (
     validate_batch,
     validate_transaction,
@@ -14,8 +18,10 @@ from realtime_fraud_detection_tpu.serving.validation import (
 __all__ = [
     "HttpError",
     "HttpServer",
+    "NoShardAvailableError",
     "RequestMicrobatcher",
     "ServingApp",
+    "ShardIngressClient",
     "validate_batch",
     "validate_transaction",
 ]

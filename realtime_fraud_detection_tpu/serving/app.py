@@ -997,10 +997,25 @@ class ServingApp:
         return self.http.port
 
     def run_forever(self) -> None:               # pragma: no cover - CLI path
+        """Serve until SIGTERM/SIGINT, then stop GRACEFULLY: the HTTP
+        server closes first (no new admissions), then the microbatcher
+        drains — every already-admitted transaction is scored and its
+        waiter resolved before the process exits. A mid-batch SIGTERM
+        loses nothing (the graceful-shutdown satellite, ISSUE 12); only
+        SIGKILL abandons in-flight work, by definition."""
+        import signal as _signal
+
         async def _main():
             await self.start()
+            stopping = asyncio.Event()
+            loop = asyncio.get_running_loop()
+            for sig in (_signal.SIGTERM, _signal.SIGINT):
+                try:
+                    loop.add_signal_handler(sig, stopping.set)
+                except (NotImplementedError, RuntimeError, ValueError):
+                    pass          # platform/thread without signal support
             try:
-                await asyncio.Event().wait()
+                await stopping.wait()
             finally:
                 await self.stop()
 

@@ -259,6 +259,16 @@ class StreamJob:
         # loop dedupes batch N+1 against these before batch N lands in the
         # txn cache (keeps effectively-once scoring under pipelining)
         self._inflight_ids: set = set()
+        # graceful-shutdown seam (cli.py installs SIGTERM/SIGINT handlers
+        # that set this): the run loops stop POLLING but still complete
+        # every dispatched batch and commit its offsets — a signal drains
+        # the in-flight tail instead of losing it to replay-on-restart
+        self.stop_requested = False
+
+    def request_stop(self) -> None:
+        """Ask the run loops to drain in-flight microbatches, commit, and
+        return (signal-handler safe: one attribute write)."""
+        self.stop_requested = True
 
     def _inflight_depth(self) -> int:
         """Run-loop in-flight window: the configured pipeline depth, set
@@ -753,6 +763,17 @@ class StreamJob:
         depth = self._inflight_depth()
         in_flight: deque = deque()
         for _ in range(max_batches):
+            if self.stop_requested:
+                # drain: dispatch the assembler's polled-but-unbatched
+                # tail too — those records' offsets are past the last
+                # commit snapshot, and leaving them unscored would replay
+                # them on every restart (the satellite this seam exists
+                # for: SIGTERM loses nothing, only SIGKILL replays)
+                tail = self.assembler.flush()
+                while tail:
+                    in_flight.append(self.dispatch_batch(tail, now=now))
+                    tail = self.assembler.flush()
+                break
             batch = self.assembler.next_batch(block=False)
             if not batch:
                 batch = self.assembler.flush()
@@ -791,7 +812,7 @@ class StreamJob:
         depth = self._inflight_depth()
         in_flight: deque = deque()
         # rtfd-lint: allow[wall-clock] consume-only slice duration is wall-bound by definition
-        while time.monotonic() < t_end:
+        while time.monotonic() < t_end and not self.stop_requested:
             batch = self.assembler.next_batch(block=True, timeout_s=0.05)
             if batch:
                 in_flight.append(self.dispatch_batch(batch))
@@ -800,6 +821,13 @@ class StreamJob:
             if self.feedback is not None \
                     and self.feedback.pending_trigger is not None:
                 self.feedback.react()
+        if self.stop_requested:
+            # same drain discipline as run_until_drained: the polled tail
+            # is scored + committed, not abandoned to replay
+            tail = self.assembler.flush()
+            while tail:
+                in_flight.append(self.dispatch_batch(tail))
+                tail = self.assembler.flush()
         while in_flight:
             self.complete_batch(in_flight.popleft())
         self.drain_labels()

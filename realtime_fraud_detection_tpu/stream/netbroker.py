@@ -900,8 +900,15 @@ class NetBrokerClient:
 
     # ------------------------------------------------------------- consume
     def consumer(self, topics: Sequence[str], group_id: str,
-                 faults: Optional[FaultInjector] = None) -> Consumer:
-        return Consumer(self, list(topics), group_id, faults)
+                 faults: Optional[FaultInjector] = None,
+                 partitions: Optional[Mapping[str, Sequence[int]]] = None,
+                 ) -> Consumer:
+        """``partitions`` scopes the consumer to an explicit topic →
+        partition-list assignment (the partition-parallel worker plane,
+        cluster/fleet.py) — same contract as ``InMemoryBroker.consumer``,
+        so a partition-scoped worker runs unchanged over TCP."""
+        return Consumer(self, list(topics), group_id, faults,
+                        partitions=partitions)
 
     def read(self, topic: str, partition: int, start: int,
              limit: int) -> List[Record]:

@@ -598,6 +598,18 @@ class ClusterSettings:
     # worker_id -> base URL, the router's redirect targets; the ring is
     # built over these ids
     workers: Dict[str, str] = field(default_factory=dict)
+    # elastic process fleet (cluster/procfleet.py + cluster/autoscale.py):
+    # worker-count bounds the autoscale controller moves between, the
+    # capacity model it divides the forecast by, and the forecast lead
+    # that lets the fleet grow BEFORE a diurnal peak (spawn latency is
+    # paid inside the lead, not inside the latency budget)
+    min_workers: int = 1
+    max_workers: int = 8
+    per_worker_tps: float = 200.0
+    autoscale_headroom: float = 1.25
+    autoscale_lead_s: float = 2.0
+    autoscale_interval_s: float = 0.5
+    autoscale_down_patience: int = 3
 
     def validate(self) -> None:
         if self.n_partitions < 1:
@@ -608,6 +620,18 @@ class ClusterSettings:
             raise ValueError(
                 "cluster.virtual_nodes and cluster.checkpoint_every "
                 "must be >= 1")
+        if not 1 <= self.min_workers <= self.max_workers:
+            raise ValueError(
+                f"cluster autoscale needs 1 <= min_workers <= "
+                f"max_workers, got {self.min_workers}..{self.max_workers}")
+        if (self.per_worker_tps <= 0 or self.autoscale_headroom < 1.0
+                or self.autoscale_lead_s < 0
+                or self.autoscale_interval_s <= 0
+                or self.autoscale_down_patience < 1):
+            raise ValueError(
+                "cluster autoscale requires per_worker_tps > 0, "
+                "headroom >= 1, lead_s >= 0, interval_s > 0 and "
+                "down_patience >= 1")
         if self.enabled:
             if not self.workers:
                 raise ValueError(
