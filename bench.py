@@ -216,6 +216,16 @@ def _compact_summary(result: dict) -> dict:
             "high_value_sheds": ch.get("high_value_sheds"),
         } if (ch := result.get("chaos") or {})
             and not ch.get("error") else None),
+        "degraded_network": ({
+            "passed": dn.get("passed"),
+            "healthy_p99_ms": dn.get("healthy_p99_ms"),
+            "healthy_tps": dn.get("healthy_tps"),
+            "slow_link_p99_ms": dn.get("slow_link_p99_ms"),
+            "slow_link_tps": dn.get("slow_link_tps"),
+            "p99_ratio": dn.get("p99_ratio"),
+            "fenced_produces": dn.get("fenced_produces"),
+        } if (dn := result.get("degraded_network") or {})
+            and not dn.get("error") else None),
         "shard_scaling": ({
             "single_worker_txn_per_s": sh.get("single_worker_txn_per_s"),
             "aggregate_txn_per_s": sh.get("aggregate_txn_per_s"),
@@ -276,7 +286,7 @@ def _compact_summary(result: dict) -> dict:
     while len(line.encode()) >= 2048:
         for victim in ("configs_txn_per_s", "operating_point", "quality",
                        "host_assembly", "mesh_scaling", "pool_scaling",
-                       "autotune", "chaos",
+                       "autotune", "chaos", "degraded_network",
                        "shard_scaling", "elastic_scaling", "quantization",
                        "latest_committed_tpu_capture",
                        "text_encoder", "error"):
@@ -1029,6 +1039,23 @@ def run_bench() -> None:
                 "error": f"{type(e).__name__}: {e}"[:200]}
         _log(f'chaos stage done: '
              f'{ {k: v for k, v in (result.get("chaos") or {}).items() if not isinstance(v, dict)} }')
+
+    # --------------------------------------------- degraded-network stage
+    # Network fault plane (chaos/netfaults.py): one fast, no-replay pass
+    # of the partition drill in a subprocess, reporting the slow-link
+    # victim's scored-traffic p99 + txn/s on a healthy link vs inside
+    # the seeded slow-link window (same shape as the chaos stage), plus
+    # the broker's producer-generation fence counters. Real OS worker
+    # processes on the CPU platform — safe on any box including a
+    # tunneled TPU session.
+    if remaining() > 90:
+        try:
+            _degraded_network_stage(result, snapshot)
+        except Exception as e:  # noqa: BLE001
+            result["degraded_network"] = {
+                "error": f"{type(e).__name__}: {e}"[:200]}
+        _log(f'degraded-network stage done: '
+             f'{ {k: v for k, v in (result.get("degraded_network") or {}).items() if not isinstance(v, dict)} }')
 
     # ------------------------------------------------ shard-scaling stage
     # Partition-parallel worker plane (cluster/): aggregate virtual txn/s
@@ -1834,6 +1861,55 @@ def _chaos_stage(result: dict, snapshot) -> None:
         "virtual_duration_s": full.get("virtual_duration_s"),
     }
     snapshot("chaos")
+
+
+def _degraded_network_stage(result: dict, snapshot) -> None:
+    """Network fault plane (ISSUE 13 bench satellite): one fast,
+    no-replay pass of the split-brain partition drill in a subprocess,
+    reporting the slow-link victim's scored-traffic p99 + txn/s on a
+    healthy link vs inside the seeded slow-link window, the injected
+    per-frame latency, and the broker's producer-generation fence
+    counters. The worker processes are pinned to the CPU platform, so a
+    tunneled TPU session is never touched; the pass/fail bar lives in
+    ``rtfd partition-drill`` and the tier-1 smoke."""
+    argv = [sys.executable, "-m", "realtime_fraud_detection_tpu",
+            "partition-drill", "--fast", "--no-replay"]
+    proc = subprocess.run(argv, capture_output=True, text=True, timeout=600,
+                          env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    full: dict = {}
+    for line in reversed(proc.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                parsed = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if "degraded_network" in parsed:   # the FULL result line
+                full = parsed                  # (final line = verdict)
+                break
+    if not full:
+        raise RuntimeError(
+            f"partition-drill produced no parseable result "
+            f"(rc={proc.returncode}): {(proc.stderr or '')[-200:]}")
+    deg = full.get("degraded_network") or {}
+    result["degraded_network"] = {
+        "passed": bool(full.get("passed")),
+        "failed_checks": sorted(k for k, v in
+                                (full.get("checks") or {}).items() if not v),
+        "worker": deg.get("worker"),
+        "injected_latency_ms": deg.get("injected_latency_ms"),
+        "healthy_p99_ms": (deg.get("healthy") or {}).get("p99_ms"),
+        "healthy_tps": (deg.get("healthy") or {}).get("tps"),
+        "slow_link_p99_ms": (deg.get("slow_link") or {}).get("p99_ms"),
+        "slow_link_tps": (deg.get("slow_link") or {}).get("tps"),
+        "p99_ratio": deg.get("p99_ratio"),
+        "fenced_produces": full.get("fenced_produces"),
+        "fenced_commits": full.get("fenced_commits"),
+        "evictions": full.get("evictions"),
+        "rejoins": full.get("rejoins"),
+        "scored_duplicates": full.get("scored_duplicates"),
+    }
+    snapshot("degraded_network")
 
 
 def _shard_scaling_stage(result: dict, snapshot) -> None:

@@ -84,10 +84,13 @@ __all__ = [
 PACKAGE_NAME = "realtime_fraud_detection_tpu"
 
 # Subsystems that can run under the drills' virtual clock: a bare wall-
-# clock read here silently diverges a replay.
+# clock read here silently diverges a replay. (chaos/ joined with
+# ISSUE 13: the ChaosPlan/link-fault layer never reads time by contract
+# — clocks and sleep seams are injected; partition_drill.py's real-
+# process pacing carries justified pragmas like elastic_drill.)
 CLOCK_SUBSYSTEMS = frozenset(
     {"qos", "tuning", "feedback", "obs", "stream", "serving", "scoring",
-     "sim", "cluster"})
+     "sim", "cluster", "chaos"})
 
 # Whole modules under the pre-pull-safe / dispatch-path d2h contract
 # (utils/timing.py rule 2: only block_until_ready inside timed sections).
@@ -124,6 +127,10 @@ D2H_FUNCTIONS: Dict[str, frozenset] = {
 # the same f32 pytree always quantizes to the same blobs).
 DETERMINISM_MODULES = frozenset({
     "models/quant.py",
+    # link-fault layer (ISSUE 13): fault schedules ride worker specs
+    # across the process boundary and must replay bit-identically inside
+    # a fresh interpreter — seeded rng instances only, no global RNG
+    "chaos/netfaults.py",
 })
 # Whole subsystems under the determinism contract: every cluster/ module
 # is replay-critical — ring placement, partition routing, handoff

@@ -4,7 +4,7 @@ The static ``lock-order`` rule (analysis/lint.py) sees the lexical
 structure; this module watches what the threads actually do. While any of
 the deterministic drills run (``rtfd lint --lockwatch`` drives pool-drill,
 trace-drill, autotune-drill, feedback-drill, qos-drill, chaos-drill,
-shard-drill and mesh-drill), every
+shard-drill, mesh-drill, elastic-drill and partition-drill), every
 ``threading.Lock`` / ``RLock`` / ``Condition`` created from package code
 is replaced by an instrumented wrapper that records, per thread:
 
@@ -45,10 +45,11 @@ _REAL_CONDITION = threading.Condition
 
 PACKAGE_MARKER = "realtime_fraud_detection_tpu"
 
-# the nine deterministic drills the watcher is validated against
+# the ten deterministic drills the watcher is validated against
 LOCKWATCH_DRILLS = ("qos-drill", "trace-drill", "autotune-drill",
                     "feedback-drill", "pool-drill", "chaos-drill",
-                    "shard-drill", "mesh-drill", "elastic-drill")
+                    "shard-drill", "mesh-drill", "elastic-drill",
+                    "partition-drill")
 
 
 class LockWatcher:
@@ -478,7 +479,7 @@ def run_drill_watched(drill: str, fast: bool = True,
                     MeshDrillConfig.fast() if fast else MeshDrillConfig(),
                     replay_check=False)
                 passed = bool(run_mesh_drill(cfg)["passed"])
-            else:   # elastic-drill
+            elif drill == "elastic-drill":
                 import dataclasses
 
                 from realtime_fraud_detection_tpu.cluster.elastic_drill import (
@@ -496,4 +497,22 @@ def run_drill_watched(drill: str, fast: bool = True,
                     else ElasticDrillConfig(),
                     replay_check=False)
                 passed = bool(run_elastic_drill(cfg)["passed"])
+            else:   # partition-drill
+                import dataclasses
+
+                from realtime_fraud_detection_tpu.chaos.partition_drill import (
+                    PartitionDrillConfig,
+                    run_partition_drill,
+                )
+
+                # single pass, same rationale as elastic-drill: the
+                # fresh-run digest is the drill's own acceptance, and
+                # the watcher covers this process's coordinator +
+                # broker/handoff server threads (the link-faulted
+                # clients live inside the worker subprocesses)
+                cfg = dataclasses.replace(
+                    PartitionDrillConfig.fast() if fast
+                    else PartitionDrillConfig(),
+                    replay_check=False)
+                passed = bool(run_partition_drill(cfg)["passed"])
     return {"drill": drill, "drill_passed": passed, "lockwatch": w.report()}

@@ -7,7 +7,10 @@ drills' virtual clock and binds them to per-layer injectors (broker
 replica outage, consumer-group member kill, device-replica death, slow
 device, label stall, flash crowd); ``chaos.drill`` composes them — plus
 the coordinated fraud ring from ``sim.fraud_patterns`` — into the
-``rtfd chaos-drill`` combined recovery drill.
+``rtfd chaos-drill`` combined recovery drill; ``chaos.netfaults``
+degrades the NETWORK itself (named links in the framing transports'
+request path: latency, throttle, bounded drops, one-way/full partitions
+— the ``rtfd partition-drill`` substrate).
 """
 
 from realtime_fraud_detection_tpu.chaos.faults import (
@@ -20,6 +23,13 @@ from realtime_fraud_detection_tpu.chaos.faults import (
     SlowDevice,
     WorkerKill,
 )
+from realtime_fraud_detection_tpu.chaos.netfaults import (
+    LinkDegrade,
+    LinkFaultPlane,
+    LinkState,
+    NetworkPartition,
+    ScheduledLink,
+)
 
 __all__ = [
     "BrokerReplicaOutage",
@@ -28,6 +38,11 @@ __all__ = [
     "DeviceReplicaDeath",
     "FaultWindow",
     "LabelStall",
+    "LinkDegrade",
+    "LinkFaultPlane",
+    "LinkState",
+    "NetworkPartition",
+    "ScheduledLink",
     "SlowDevice",
     "WorkerKill",
 ]

@@ -1346,9 +1346,48 @@ def cmd_elastic_drill(args: argparse.Namespace) -> int:
     return 0 if summary["passed"] else 1
 
 
+def cmd_partition_drill(args: argparse.Namespace) -> int:
+    """Deterministic split-brain partition drill (chaos/partition_drill
+    .py): >= 4 real OS worker processes over the TCP netbroker while the
+    link-fault layer (chaos/netfaults.py) degrades the network itself —
+    an asymmetric partition at the busiest worker (deaf to the
+    coordinator, data path alive: evicted by session expiry, fenced at
+    the broker's producer-generation seam, its post-fence produces
+    REFUSED and counted), a slow link under load (healthy-vs-window p99
+    reported as degraded_network), and a full partition that heals
+    (bounded backoff, fenced discovery, fresh rejoin). Pins zero lost /
+    conflicting-scored vs a single-process oracle, gap-free offsets,
+    state equality, detection inside the session-timeout bound, both
+    rejoins with no double-ownership interval, bounded byte-identical
+    duplicates, and a digest-identical second fresh run. Prints the full
+    summary, then a compact (<2 KB) verdict as the FINAL stdout line
+    (bench.py convention). Exit 1 unless every check passed. Pure host
+    arithmetic in the workers — no device needed, but REAL processes,
+    REAL TCP, REAL link faults."""
+    import dataclasses as _dc
+
+    from realtime_fraud_detection_tpu.chaos.partition_drill import (
+        PartitionDrillConfig,
+        compact_partition_summary,
+        run_partition_drill,
+    )
+
+    cfg = (PartitionDrillConfig.fast() if args.fast
+           else PartitionDrillConfig())
+    cfg = _dc.replace(cfg, seed=args.seed,
+                      replay_check=not args.no_replay,
+                      **({"n_workers": args.workers} if args.workers
+                         else {}))
+    summary = run_partition_drill(cfg)
+    print(json.dumps(summary), flush=True)
+    print(json.dumps(compact_partition_summary(summary),
+                     separators=(",", ":")), flush=True)
+    return 0 if summary["passed"] else 1
+
+
 def cmd_lint(args: argparse.Namespace) -> int:
     """Run the repo-native invariant checker (analysis/lint.py) — or, with
-    --lockwatch, the dynamic lock-order watcher under all nine
+    --lockwatch, the dynamic lock-order watcher under all ten
     deterministic drills (analysis/lockwatch.py). Exit 0 only when clean.
 
     The static rules (wall-clock, d2h, metrics, lock-order, determinism,
@@ -1910,6 +1949,22 @@ def build_parser() -> argparse.ArgumentParser:
                     help="skip the second fresh determinism run")
     sp.set_defaults(fn=cmd_elastic_drill)
 
+    sp = sub.add_parser("partition-drill",
+                        help="deterministic split-brain partition drill: "
+                             ">= 4 real OS worker processes under link "
+                             "chaos (asymmetric/slow/full partitions), "
+                             "broker producer-generation fencing, "
+                             "session-expiry eviction + fresh rejoin, "
+                             "oracle state equality")
+    sp.add_argument("--fast", action="store_true",
+                    help="tier-1 sizes (the CI smoke configuration)")
+    sp.add_argument("--workers", type=int, default=0,
+                    help="fleet size (0 = the config default)")
+    sp.add_argument("--seed", type=int, default=7)
+    sp.add_argument("--no-replay", action="store_true",
+                    help="skip the second fresh determinism run")
+    sp.set_defaults(fn=cmd_partition_drill)
+
     sp = sub.add_parser("lint",
                         help="repo-native invariant checker (static rules "
                              "+ --lockwatch dynamic lock-order watcher)")
@@ -1918,7 +1973,7 @@ def build_parser() -> argparse.ArgumentParser:
                          "+ bench.py)")
     sp.add_argument("--format", choices=("text", "json"), default="text")
     sp.add_argument("--lockwatch", action="store_true",
-                    help="run the nine deterministic drills under the "
+                    help="run the ten deterministic drills under the "
                          "instrumented lock watcher instead of the static "
                          "rules")
     sp.add_argument("--lockwatch-run", default="",

@@ -207,6 +207,30 @@ class ClusterWorker:
         self.checkpoints += 1
         return len(self.store.owned())
 
+    def abandon(self) -> int:
+        """Fenced-writer recovery: drop every owned partition WITHOUT
+        checkpointing. This worker lost its partitions in a rebalance it
+        never observed (asymmetric partition, session expiry) — the
+        inheritors restored from the last good checkpoint and replayed
+        the committed gap, so THEIR state is the truth; a checkpoint from
+        here would carry a stale epoch (refused by the handoff fence) and
+        must not even be attempted. Pending assembler records are
+        discarded too: nothing for a lost partition may be dispatched.
+        Returns the number of partitions dropped; the worker re-enters
+        the fleet as a fresh member (hello → rebalance → restore)."""
+        while True:
+            batch = self.assembler.next_batch(block=False) \
+                or self.assembler.flush()
+            if not batch:
+                break
+        dropped = 0
+        for p in list(self.store.owned()):
+            self.store.release(p)
+            dropped += 1
+        self.consumer.set_assignment({self.topic: []})
+        self.in_flight.clear()
+        return dropped
+
     def on_batch_complete(self) -> None:
         """Drive-loop hook after each ``complete_batch``: every
         ``checkpoint_every`` completions, snapshot ONE owned partition

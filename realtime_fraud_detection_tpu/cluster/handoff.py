@@ -32,10 +32,12 @@ from __future__ import annotations
 
 import base64
 import hashlib
+import json
 import os
 import socket
 import socketserver
 import threading
+import time
 from pathlib import Path
 from typing import Any, Dict, Mapping, Optional, Tuple
 
@@ -307,7 +309,7 @@ class HandoffClient:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 9095,
                  timeout_s: float = 30.0, reconnect_attempts: int = 6,
-                 retry_sleep=None):
+                 retry_sleep=None, link=None):
         from realtime_fraud_detection_tpu.utils.backoff import (
             DeterministicBackoff,
             instance_seed,
@@ -319,6 +321,10 @@ class HandoffClient:
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._lock = threading.Lock()
         self._reconnect_attempts = max(0, int(reconnect_attempts))
+        # optional in-path chaos link (chaos/netfaults.py) — None in
+        # production; the partition drill degrades/partitions this
+        # connection exactly like the broker one
+        self._link = link
         self.backoff = DeterministicBackoff(
             base_s=0.05, mult=2.0, max_s=1.0,
             seed=instance_seed(f"handoff:{port}"), sleep=retry_sleep)
@@ -335,12 +341,33 @@ class HandoffClient:
         resp = None
         last: Optional[Exception] = None
         for attempt in range(self._reconnect_attempts + 1):
+            resp = None
             try:
                 with self._lock:
+                    if self._link is not None:
+                        # frame size for byte-paced throttling (the
+                        # double serialization is paid only while a
+                        # chaos link is attached)
+                        self._link.before_send(
+                            req, len(json.dumps(
+                                req, separators=(",", ":")).encode()))
                     _send_frame(self._sock, req)
-                    resp = _recv_frame(self._sock)
+                    # bounded whole-frame read: a SIGSTOP'd handoff
+                    # server cannot wedge a restoring worker forever
+                    deadline = time.monotonic() + self._timeout_s  # rtfd-lint: allow[wall-clock] socket I/O deadline is genuinely wall-bound
+                    try:
+                        resp = _recv_frame(self._sock, deadline=deadline)
+                    finally:
+                        # restore the full op timeout: the deadline path
+                        # shrinks it to the residual budget
+                        try:
+                            self._sock.settimeout(self._timeout_s)
+                        except OSError:
+                            pass
                 if resp is None:
                     raise ConnectionError("handoff server closed connection")
+                if self._link is not None:
+                    self._link.after_recv(req)
                 break
             except (ConnectionError, OSError) as e:
                 last = e
@@ -362,7 +389,14 @@ class HandoffClient:
         if resp is None:
             raise ConnectionError(f"handoff server unreachable: {last}")
         if "error" in resp:
-            raise RuntimeError(f"handoff error: {resp['error']}")
+            msg = str(resp["error"])
+            if msg.startswith("FencedEpochError"):
+                # typed re-raise: the fenced-writer path (a worker that
+                # lost its partitions in an unobserved rebalance) must be
+                # distinguishable from a genuine server error — the
+                # worker's response is abandon-and-rejoin, not crash
+                raise FencedEpochError(f"handoff refused: {msg}")
+            raise RuntimeError(f"handoff error: {msg}")
         return resp
 
     # -------------------------------------------------- HandoffStore surface

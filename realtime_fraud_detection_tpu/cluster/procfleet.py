@@ -98,7 +98,9 @@ class ProcessFleet:
                  worker_spec: Optional[Dict[str, Any]] = None,
                  python: str = sys.executable,
                  ack_timeout_s: float = 90.0,
-                 spawn_env: Optional[Dict[str, str]] = None):
+                 spawn_env: Optional[Dict[str, str]] = None,
+                 session_timeout_s: float = 30.0,
+                 per_worker_spec: Optional[Dict[str, Dict[str, Any]]] = None):
         from realtime_fraud_detection_tpu.stream.netbroker import (
             NetBrokerClient,
         )
@@ -121,6 +123,11 @@ class ProcessFleet:
         self.ring = HashRing([], virtual_nodes=virtual_nodes)
         self.generation = 0
         self.worker_spec = dict(worker_spec or {})
+        # per-worker overlays on top of worker_spec (keyed by worker id):
+        # the partition drill stamps each target's scheduled link-fault
+        # windows + phase windows into exactly that worker's spec
+        self.per_worker_spec = {k: dict(v)
+                                for k, v in (per_worker_spec or {}).items()}
         # wid -> {"proc", "pid", "alive", "ready", "summary"}
         self.workers: Dict[str, Dict[str, Any]] = {}
         self._next_idx = 0
@@ -128,22 +135,38 @@ class ProcessFleet:
         self._byes: Dict[str, Dict[str, Any]] = {}
         self._last_assignment: Dict[str, List[int]] = {}
         self._pending_deaths: List[str] = []
+        self._pending_rejoins: List[str] = []
+        self._pending_evictions: List[str] = []
         self._in_rebalance = False
         self.events: List[Dict[str, Any]] = []
         self.kills = 0
         self.spawns = 0
+        self.evictions = 0
+        self.rejoins = 0
         self.handoffs_total = 0
         self.replayed_total = 0
         self.last_replay_depth = 0
         self.rebalance_pauses_s: List[float] = []
+        # liveness: a worker whose heartbeats (or any event) go silent
+        # past session_timeout_s is EVICTED from the ring — its process
+        # may be alive but deaf (the asymmetric-partition zombie); its
+        # partitions are fenced + reassigned, and when it can reach the
+        # control plane again it rejoins as a fresh member (hello).
+        # This is the Kafka session-expiry analog on the broker-carried
+        # membership plane; process reaping stays the fast path for
+        # actual deaths.
+        self.session_timeout_s = float(session_timeout_s)
 
     # ------------------------------------------------------------ membership
     def alive_ids(self) -> List[str]:
         return sorted(w for w, st in self.workers.items() if st["alive"])
 
     def ready_ids(self) -> List[str]:
+        # an evicted worker is alive-but-deaf: never expected to ack,
+        # never counted toward the serving fleet until it rejoins
         return sorted(w for w, st in self.workers.items()
-                      if st["alive"] and st["ready"])
+                      if st["alive"] and st["ready"]
+                      and not st.get("evicted"))
 
     def assignment(self) -> Dict[str, List[int]]:
         if not self.ring.members():
@@ -155,6 +178,7 @@ class ProcessFleet:
         self._next_idx = max(self._next_idx,
                              int(wid[1:]) + 1 if wid[1:].isdigit() else 0)
         spec = dict(self.worker_spec)
+        spec.update(self.per_worker_spec.get(wid, {}))
         spec.update(broker=self.broker_addr, handoff=self.handoff_addr,
                     worker_id=wid, group_id=self.group_id,
                     topic=self.topic, n_partitions=self.n_partitions)
@@ -164,7 +188,8 @@ class ProcessFleet:
             env=self.spawn_env)
         self.workers[wid] = {"proc": proc, "pid": proc.pid, "alive": True,
                              "ready": False, "summary": None,
-                             "joined_gen": None}
+                             "joined_gen": None, "evicted": False,
+                             "last_hb": _mono()}
         self.spawns += 1
         return wid
 
@@ -211,14 +236,24 @@ class ProcessFleet:
             ev = r.value if isinstance(r.value, dict) else {}
             kind = ev.get("type")
             wid = str(ev.get("worker", ""))
-            if kind == "hello" and wid in self.workers:
-                self.workers[wid]["ready"] = True
+            st = self.workers.get(wid)
+            if st is not None and kind in ("hello", "hb", "ack", "bye"):
+                # ANY event is proof of life on the control plane
+                st["last_hb"] = _mono()
+            if kind == "hello" and st is not None:
+                st["ready"] = True
+                if st.get("evicted") and st["alive"] \
+                        and wid not in self._pending_rejoins:
+                    # an evicted worker that can reach the control plane
+                    # again rejoins as a FRESH member: queued (never
+                    # executed from inside a rebalance's ack wait) and
+                    # batched into one rebalance by _process_rejoins
+                    self._pending_rejoins.append(wid)
             elif kind == "ack":
                 self._acks[(wid, int(ev.get("generation", -1)),
                             str(ev.get("phase", "")))] = ev
             elif kind == "bye":
                 self._byes[wid] = ev
-                st = self.workers.get(wid)
                 if st is not None:
                     st["summary"] = ev
 
@@ -226,7 +261,8 @@ class ProcessFleet:
         self.client.produce(CONTROL_TOPIC, msg, key="ctl")
 
     def _wait_acks(self, ids: Sequence[str], generation: int,
-                   phase: str) -> List[Dict[str, Any]]:
+                   phase: str,
+                   now: Optional[float] = None) -> List[Dict[str, Any]]:
         """Collect (worker, generation, phase) acks; a worker that DIES
         while we wait is dropped from the expectation — its partitions
         recover through the death path (queued, run after this
@@ -239,10 +275,20 @@ class ProcessFleet:
             for wid in list(pending):
                 if (wid, generation, phase) in self._acks:
                     pending.discard(wid)
-                elif not self.workers[wid]["alive"]:
+                elif not self.workers[wid]["alive"] \
+                        or self.workers[wid].get("evicted"):
+                    # dead OR evicted mid-wait: the fence (not this
+                    # worker's cooperation) is what protects the moved
+                    # partitions — drop it from the expectation
                     pending.discard(wid)
             if not pending:
                 break
+            # a releaser that goes SILENT while we wait is expired here
+            # (mark-only — the ring change + recovery rebalance defer to
+            # _recover_evictions), so one deaf worker cannot wedge the
+            # whole fleet's rebalance until the ack timeout; the caller's
+            # clock rides along so the eviction event keeps its timestamp
+            self._expire_sessions(now)
             if _mono() > deadline:
                 raise RuntimeError(
                     f"rebalance gen {generation} phase {phase}: no ack "
@@ -273,18 +319,34 @@ class ProcessFleet:
                            if owner_old and owner_old.get(p) != w)
             releasers = sorted({owner_old[p] for p in moved
                                 if owner_old.get(p) in self.workers
-                                and self.workers[owner_old[p]]["alive"]})
+                                and self.workers[owner_old[p]]["alive"]
+                                and not self.workers[
+                                    owner_old[p]].get("evicted")})
             wire_assign = {w: sorted(ps) for w, ps in new_assign.items()}
             if releasers:
                 self._publish({"type": "assign", "generation": gen,
                                "phase": "release",
                                "assignment": wire_assign})
-                self._wait_acks(releasers, gen, "release")
+                self._wait_acks(releasers, gen, "release", now=now)
             for p in moved:
                 self.handoff.fence(p, gen)
+            if moved:
+                # the WRITE-seam half of the fence step: a releaser that
+                # never saw (or never acked) the release — the asymmetric
+                # -partition zombie — has its stamped produces AND offset
+                # commits refused by the broker from this instant
+                # (StaleGenerationError), for the moved transaction
+                # partitions and their index-aligned prediction
+                # partitions (both topics partition by the same crc32
+                # user key, so partition p of one IS partition p of the
+                # other; the alerts fan-out rides the same refusal
+                # because predictions produce first in _finish_batch).
+                self.client.fence_producers(self.topic, moved, gen)
+                self.client.fence_producers(T.PREDICTIONS, moved, gen)
             self._publish({"type": "assign", "generation": gen,
                            "phase": "acquire", "assignment": wire_assign})
-            acks = self._wait_acks(self.ready_ids(), gen, "acquire")
+            acks = self._wait_acks(self.ready_ids(), gen, "acquire",
+                                   now=now)
             replayed = sum(int(a.get("replayed", 0)) for a in acks)
             acquired = sum(int(a.get("acquired", 0)) for a in acks)
             pause = round(_mono() - t0, 4)
@@ -303,6 +365,66 @@ class ProcessFleet:
         finally:
             self._in_rebalance = False
         return event
+
+    # ------------------------------------------------ session expiry/rejoin
+    def _expire_sessions(self, now: Optional[float]) -> None:
+        """Mark ring members whose control plane went silent past
+        ``session_timeout_s`` as EVICTED (heartbeats, acks, hellos and
+        byes all count as life). Mark-only — safe from inside a
+        rebalance's ack wait; the ring removal + recovery rebalance
+        happen in :meth:`_recover_evictions` once no rebalance runs. The
+        worker process may well be alive (asymmetric partition): its
+        partitions are fenced at the new generation, so whatever it
+        still produces is refused at the broker, and it rejoins as a
+        fresh member when its hello gets through again."""
+        for wid, st in self.workers.items():
+            if st["alive"] and st["ready"] and not st.get("evicted") \
+                    and wid in self.ring.members() \
+                    and _mono() - st["last_hb"] > self.session_timeout_s:
+                st["evicted"] = True
+                self.evictions += 1
+                self._pending_evictions.append(wid)
+                self.events.append({
+                    "event": "session_expired", "worker": wid, "t": now,
+                    "silent_s": round(_mono() - st["last_hb"], 3)})
+
+    def _recover_evictions(self, now: Optional[float]) -> None:
+        if self._in_rebalance or not self._pending_evictions:
+            return
+        evicted = [w for w in self._pending_evictions
+                   if w in self.ring.members()]
+        self._pending_evictions.clear()
+        if not evicted:
+            return
+        for wid in evicted:
+            self.ring.remove(wid)
+        if not self.ring.members():
+            raise RuntimeError("all workers evicted or dead")
+        self._rebalance(reason=f"session_timeout:{'+'.join(evicted)}",
+                        now=now)
+
+    def _process_rejoins(self, now: Optional[float]) -> None:
+        """Admit evicted workers whose hello got through again — batched
+        into ONE rebalance, never run from inside another rebalance. A
+        rejoiner is a FRESH member: its seniority resets (the busiest-
+        senior kill targeting must not treat a rejoin as tenure) and it
+        restores every acquired partition from the handoff store exactly
+        like a scale-up joiner."""
+        if self._in_rebalance or not self._pending_rejoins:
+            return
+        rejoin = sorted({w for w in self._pending_rejoins
+                         if self.workers[w]["alive"]
+                         and self.workers[w].get("evicted")})
+        self._pending_rejoins.clear()
+        if not rejoin:
+            return
+        for wid in rejoin:
+            st = self.workers[wid]
+            st["evicted"] = False
+            st["joined_gen"] = None     # fresh member, fresh seniority
+            self._join_ring(wid)
+            self.rejoins += 1
+        self._rebalance(reason=f"rejoin:{'+'.join(rejoin)}", now=now)
 
     # ------------------------------------------------------- death handling
     def _note_deaths(self) -> None:
@@ -418,7 +540,8 @@ class ProcessFleet:
         in_ring = [w for w in self.ring.members()
                    if self.workers[w]["alive"]]
         pending = [w for w, st in self.workers.items()
-                   if st["alive"] and w not in self.ring.members()]
+                   if st["alive"] and not st.get("evicted")
+                   and w not in self.ring.members()]
         for _ in range(target - len(in_ring) - len(pending)):
             pending.append(self.spawn_worker())
         joinable = [w for w in pending if self.workers[w]["ready"]]
@@ -466,10 +589,21 @@ class ProcessFleet:
         self.workers[wid]["proc"].wait(timeout=30)
         return self._byes[wid]
 
+    def announce_epoch(self, t0: float) -> None:
+        """Publish the shared fault-window epoch over the control topic:
+        workers anchor their scheduled link faults (and latency phase
+        classification) to it, so one wall instant is the whole fleet's
+        window t=0 — announced BEFORE any window opens."""
+        self._publish({"type": "epoch", "t0": float(t0)})
+
     def tick(self, now: Optional[float] = None) -> None:
-        """One coordinator heartbeat: drain events, reap deaths."""
+        """One coordinator heartbeat: drain events, reap deaths, expire
+        silent sessions, recover evictions, admit rejoins."""
         self.poll_events()
         self._reap(now)
+        self._expire_sessions(now)
+        self._recover_evictions(now)
+        self._process_rejoins(now)
 
     def all_byes(self) -> Dict[str, Dict[str, Any]]:
         """Every bye ever received — drained workers' final summaries
@@ -514,6 +648,7 @@ class ProcessFleet:
             "workers_alive": len(self.alive_ids()),
             "workers": {
                 wid: {"alive": st["alive"], "pid": st["pid"],
+                      "evicted": bool(st.get("evicted")),
                       "partitions_owned": len(assign.get(wid, ()))}
                 for wid, st in sorted(self.workers.items())
             },
@@ -522,6 +657,8 @@ class ProcessFleet:
             "last_replay_depth": self.last_replay_depth,
             "kills": self.kills,
             "spawns": self.spawns,
+            "evictions": self.evictions,
+            "rejoins": self.rejoins,
             "rebalance_pauses_s": list(self.rebalance_pauses_s),
             "events": list(self.events),
         }
@@ -557,15 +694,48 @@ def worker_main(spec: Dict[str, Any]) -> int:
     """
     from realtime_fraud_detection_tpu.cluster.drill import ShardScorer
     from realtime_fraud_detection_tpu.cluster.fleet import ClusterWorker
+    from realtime_fraud_detection_tpu.cluster.handoff import (
+        FencedEpochError,
+    )
     from realtime_fraud_detection_tpu.cluster.partition import (
         PartitionedStore,
     )
-    from realtime_fraud_detection_tpu.stream.netbroker import NetBrokerClient
+    from realtime_fraud_detection_tpu.stream.netbroker import (
+        NetBrokerClient,
+        StaleGenerationError,
+    )
+    from realtime_fraud_detection_tpu.utils.backoff import (
+        DeterministicBackoff,
+        instance_seed,
+    )
 
     wid = str(spec["worker_id"])
     bh, _, bp = str(spec["broker"]).rpartition(":")
     hh, _, hp = str(spec["handoff"]).rpartition(":")
-    client = NetBrokerClient(host=bh or "127.0.0.1", port=int(bp))
+    # optional scheduled link faults (chaos/netfaults.py): the drill
+    # stamps this worker's fault windows into the spec; the shared epoch
+    # (window t=0) arrives over the control topic before any window
+    # opens, so until then the clock reads -inf and the plan never fires
+    epoch = {"t0": None}
+
+    def _fault_clock() -> float:
+        t0 = epoch["t0"]
+        return (_wall() - t0) if t0 is not None else float("-inf")
+
+    link = None
+    nf = spec.get("netfaults") or {}
+    if nf.get("windows"):
+        from realtime_fraud_detection_tpu.chaos.netfaults import (
+            scheduled_link_from_spec,
+        )
+
+        link = scheduled_link_from_spec(
+            nf["windows"], role=f"worker-{wid}", peer="broker",
+            clock=_fault_clock, seed=int(nf.get("seed", 0)))
+    client = NetBrokerClient(
+        host=bh or "127.0.0.1", port=int(bp),
+        reconnect_attempts=int(spec.get("reconnect_attempts", 5)),
+        link=link)
     handoff = HandoffClient(host=hh or "127.0.0.1", port=int(hp))
     store = PartitionedStore(
         int(spec.get("n_partitions", 12)),
@@ -619,6 +789,22 @@ def worker_main(spec: Dict[str, Any]) -> int:
     # in-flight-depth dimension); bounded, stride-decimated
     lat_by_depth: Dict[int, List[float]] = {}
     lat_seen = 0
+    # per-phase latency (the partition drill's degraded_network story):
+    # completions classified against the spec's named windows relative
+    # to the shared epoch — the slow-link victim reports its in-window
+    # p99 next to its own healthy p99
+    phase_windows = {str(k): (float(v[0]), float(v[1]))
+                     for k, v in (spec.get("phase_windows") or {}).items()}
+    lat_by_phase: Dict[str, List[float]] = {}
+
+    def _phase_of(t_done: float) -> str:
+        t0 = epoch["t0"]
+        if t0 is not None:
+            rel = t_done - t0
+            for label, (s, e) in phase_windows.items():
+                if s <= rel < e:
+                    return label
+        return "healthy"
 
     def _complete(ctx, done_at: float, depth: int) -> None:
         nonlocal lat_seen
@@ -628,12 +814,17 @@ def worker_main(spec: Dict[str, Any]) -> int:
         t_done = _wall()
         if ctx is not None:
             job.complete_batch(ctx, now=t_done)
+            phase = _phase_of(t_done)
             for r in ctx.fresh:
                 lat_seen += 1
                 if lat_seen % 4 == 0 or len(ctx.fresh) < 8:
                     bucket = lat_by_depth.setdefault(depth, [])
                     if len(bucket) < 4096 and r.timestamp:
                         bucket.append((t_done - r.timestamp) * 1e3)
+                if r.timestamp:
+                    pbucket = lat_by_phase.setdefault(phase, [])
+                    if len(pbucket) < 65536:
+                        pbucket.append((t_done - r.timestamp) * 1e3)
         worker.on_batch_complete()
 
     def _drain_in_flight() -> None:
@@ -654,9 +845,34 @@ def worker_main(spec: Dict[str, Any]) -> int:
             _complete(ctx, _wall() + scorer.cost_s(len(batch)),
                       job._inflight_depth())
 
+    fenced = {"abandons": 0, "stale_generation": 0, "fenced_epoch": 0,
+              "partitions_dropped": 0}
+    rejoin = {"pending": False, "next_try": 0.0}
+
+    def _abandon(why: str) -> None:
+        """Fenced-writer recovery: a rebalance we never observed moved
+        our partitions (asymmetric partition → session expiry). Drop all
+        local ownership WITHOUT checkpointing (the inheritors' restored
+        state is the truth; our epoch is fenced anyway), then re-enter
+        the fleet as a fresh member once a hello gets through."""
+        nonlocal busy_until
+        fenced["abandons"] += 1
+        in_flight.clear()
+        fenced["partitions_dropped"] += worker.abandon()
+        busy_until = 0.0
+        # unstamped until the next adopted assignment: an abandoned
+        # worker's only writes are control-plane events, never fenced
+        client.generation = None
+        rejoin["pending"] = True
+        rejoin["next_try"] = 0.0
+
     def _handle_control(msg: Dict[str, Any]) -> None:
         kind = msg.get("type")
-        if kind == "assign":
+        if kind == "epoch":
+            # the drill coordinator's shared window epoch (netfault
+            # schedules + phase classification are relative to it)
+            epoch["t0"] = float(msg["t0"])
+        elif kind == "assign":
             gen = int(msg.get("generation", 0))
             assignment = msg.get("assignment") or {}
             mine = sorted(int(p) for p in assignment.get(wid, ()))
@@ -675,7 +891,21 @@ def worker_main(spec: Dict[str, Any]) -> int:
                     "phase": "release",
                     "released": counts["released"]}, key=wid)
             elif phase == "acquire":
+                if wid not in assignment and store.owned():
+                    # a rebalance we never released for: we were EVICTED
+                    # (the coordinator stopped hearing us). Adopting this
+                    # epoch and release-checkpointing here would race the
+                    # inheritors' restores with stale state — abandon
+                    # instead; the coordinator is not waiting for an ack
+                    # from an evicted member.
+                    _abandon("excluded-from-assignment")
+                    return
                 handoff.epoch = gen
+                # stamp every later produce/commit with the adopted
+                # generation: the broker refuses the stamp once a newer
+                # rebalance fences our partitions (StaleGenerationError
+                # -> _abandon), closing the zombie-writer window
+                client.generation = gen
                 counts = worker.set_assignment(mine)
                 client.produce(EVENTS_TOPIC, {
                     "type": "ack", "worker": wid, "generation": gen,
@@ -703,12 +933,25 @@ def worker_main(spec: Dict[str, Any]) -> int:
                     "p50_ms": round(interpolated_percentile(s, 0.50), 3),
                     "p99_ms": round(interpolated_percentile(s, 0.99), 3),
                 }
+        phase_stats = {}
+        for label, vals in sorted(lat_by_phase.items()):
+            if vals:
+                s = sorted(vals)
+                phase_stats[label] = {
+                    "n": len(s),
+                    "p50_ms": round(interpolated_percentile(s, 0.50), 3),
+                    "p99_ms": round(interpolated_percentile(s, 0.99), 3),
+                }
         bye = {"type": "bye", "worker": wid, "graceful": True,
                "reason": stop["reason"], "final_checkpoints": n_ckpt,
                "digests": digests, "counters": dict(job.counters),
                "checkpoints": worker.checkpoints,
                "replayed_total": worker.replayed_total,
-               "latency_by_depth": depth_stats}
+               "latency_by_depth": depth_stats,
+               "latency_phases": phase_stats,
+               "fenced": dict(fenced),
+               "link": (link.state.snapshot_entry()
+                        if link is not None else None)}
         if job.tuning is not None:
             snap = job.tuning.snapshot()
             bye["autotune"] = {
@@ -716,35 +959,100 @@ def worker_main(spec: Dict[str, Any]) -> int:
                 "counters": snap["tuner"]["counters"]}
         client.produce(EVENTS_TOPIC, bye, key=wid)
 
+    hb_s = float(spec.get("heartbeat_s", 1.0))
+    next_hb = 0.0
+    next_ctl = 0.0
+    # outer-loop resilience: the client's OWN reconnect retries are
+    # bounded; past them the worker backs off deterministically and
+    # stays alive until the link heals (full partition, broker restart,
+    # SIGSTOP'd broker) — process death is for SIGKILL, not for weather
+    conn_backoff = DeterministicBackoff(
+        base_s=0.05, mult=2.0, max_s=1.0,
+        seed=instance_seed(f"worker:{wid}"))
+    conn_attempt = 0
+
     try:
         while True:
-            recs = client.read(CONTROL_TOPIC, 0, ctl_pos, 64)
-            for r in recs:
-                ctl_pos = r.offset + 1
-                if isinstance(r.value, dict):
-                    _handle_control(r.value)
-            if stop["reason"] is not None:
-                _say_bye()
-                return 0
-            progressed = False
-            while in_flight and in_flight[0][1] <= _wall():
-                _complete(*in_flight.popleft())
-                progressed = True
-            if len(in_flight) < job._inflight_depth():
-                batch = worker.assembler.next_batch(block=False)
-                if batch:
-                    now = _wall()
-                    ctx = job.dispatch_batch(batch, now=now)
-                    start = max(now, busy_until)
-                    done = start + scorer.cost_s(len(batch))
-                    busy_until = done
-                    in_flight.append((ctx, done, job._inflight_depth()))
-                    progressed = True
-            if not progressed:
-                if in_flight:
+            try:
+                # ---- control plane, fault-isolated: an asymmetric
+                # partition (deaf to the coordinator, data path alive)
+                # must not stall scoring — that IS the zombie scenario
+                # the broker's generation fence closes
+                if _wall() >= next_ctl:
+                    try:
+                        recs = client.read(CONTROL_TOPIC, 0, ctl_pos, 64)
+                        for r in recs:
+                            if isinstance(r.value, dict):
+                                _handle_control(r.value)
+                            # advance only past HANDLED messages: a
+                            # transient failure mid-handler re-polls the
+                            # same record instead of silently skipping
+                            # an assignment
+                            ctl_pos = r.offset + 1
+                        next_ctl = 0.0
+                    except (ConnectionError, OSError):
+                        next_ctl = _wall() + 0.5
+                if stop["reason"] is not None:
+                    _say_bye()
+                    return 0
+                # ---- heartbeat (silence IS the eviction signal; a
+                # partitioned worker keeps scoring regardless)
+                if _wall() >= next_hb:
+                    next_hb = _wall() + hb_s
+                    try:
+                        client.produce(EVENTS_TOPIC,
+                                       {"type": "hb", "worker": wid},
+                                       key=wid)
+                    except (ConnectionError, OSError):
+                        pass
+                # ---- fenced: rejoin as a fresh member once the control
+                # plane lets a hello through (cursor jumps to the topic
+                # END first — pre-eviction assignments are history)
+                if rejoin["pending"] and _wall() >= rejoin["next_try"]:
+                    try:
+                        ctl_pos = client.end_offsets(CONTROL_TOPIC)[0]
+                        client.produce(EVENTS_TOPIC,
+                                       {"type": "hello", "worker": wid,
+                                        "pid": os.getpid(),
+                                        "rejoin": True}, key=wid)
+                        rejoin["pending"] = False
+                    except (ConnectionError, OSError):
+                        rejoin["next_try"] = _wall() + 0.5
+                # ---- data plane
+                progressed = False
+                while in_flight and in_flight[0][1] <= _wall():
                     _complete(*in_flight.popleft())
-                else:
-                    time.sleep(0.005)
+                    progressed = True
+                if len(in_flight) < job._inflight_depth():
+                    batch = worker.assembler.next_batch(block=False)
+                    if batch:
+                        now = _wall()
+                        ctx = job.dispatch_batch(batch, now=now)
+                        start = max(now, busy_until)
+                        done = start + scorer.cost_s(len(batch))
+                        busy_until = done
+                        in_flight.append((ctx, done,
+                                          job._inflight_depth()))
+                        progressed = True
+                if not progressed:
+                    if in_flight:
+                        _complete(*in_flight.popleft())
+                    else:
+                        time.sleep(0.005)
+                conn_attempt = 0
+            except StaleGenerationError:
+                # the broker's producer-generation fence: a rebalance we
+                # never observed moved our partitions — whatever we just
+                # tried to write was refused whole, nothing landed
+                fenced["stale_generation"] += 1
+                _abandon("stale-generation")
+            except FencedEpochError:
+                # same story at the checkpoint seam (handoff epoch)
+                fenced["fenced_epoch"] += 1
+                _abandon("fenced-epoch")
+            except (ConnectionError, OSError):
+                conn_backoff.sleep(min(conn_attempt, 8))
+                conn_attempt += 1
     finally:
         client.close()
         handoff.close()

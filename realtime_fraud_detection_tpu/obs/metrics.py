@@ -659,6 +659,52 @@ class MetricsCollector:
             "mesh_completed_total",
             "Microbatches completed by each mesh replica", ("replica",))
         self._mesh_seen: Dict[Tuple[str, str], float] = {}
+        # network fault plane (chaos/netfaults.py) + broker producer-
+        # generation fencing (stream/netbroker.py): per-link injected
+        # fault effects and the broker's refused-write counters —
+        # mirrored from LinkFaultPlane.snapshot() (optionally carrying a
+        # broker fencing block) by sync_netfaults at exposition time
+        # (honest counter deltas, same discipline as every sync_* mirror
+        # above)
+        self.netfault_link_active = r.gauge(
+            "netfault_link_active",
+            "1 while any fault (partition/degrade) is armed on the named "
+            "link", ("link",))
+        self.netfault_windows = r.counter(
+            "netfault_windows_total",
+            "Fault windows begun on the named link", ("link",))
+        self.netfault_delayed_sends = r.counter(
+            "netfault_delayed_sends_total",
+            "Frames delayed by injected latency on the named link",
+            ("link",))
+        self.netfault_dropped_sends = r.counter(
+            "netfault_dropped_sends_total",
+            "Frames dropped (bounded drop-then-reconnect) on the named "
+            "link", ("link",))
+        self.netfault_partitioned_sends = r.counter(
+            "netfault_partitioned_sends_total",
+            "Frames refused at send by a full partition on the named "
+            "link", ("link",))
+        self.netfault_lost_responses = r.counter(
+            "netfault_lost_responses_total",
+            "Responses lost to a one-way partition on the named link "
+            "(the op was APPLIED peer-side; retries may duplicate)",
+            ("link",))
+        self.netfault_throttled_bytes = r.counter(
+            "netfault_throttled_bytes_total",
+            "Bytes paced by slow-link throttling on the named link",
+            ("link",))
+        self.fenced_produce = r.counter(
+            "fenced_produce_total",
+            "Stamped produces the broker refused because the target "
+            "partition was fenced at a newer assignment generation "
+            "(StaleGenerationError — the zombie-writer fence)")
+        self.fenced_commit = r.counter(
+            "fenced_commit_total",
+            "Stamped offset commits the broker refused at the "
+            "generation fence (a zombie's commit must not advance the "
+            "group past refused predictions)")
+        self._netfault_seen: Dict[Tuple[str, str], float] = {}
 
     def sync_host_stats(self, host_stats: Mapping[str, Any]) -> None:
         """Mirror ``FraudScorer.host_stats()`` into the Prometheus series.
@@ -924,6 +970,49 @@ class MetricsCollector:
                 if delta > 0:
                     counter.inc(delta, replica=str(replica))
                 self._mesh_seen[key] = float(total)
+
+    def sync_netfaults(self, snapshot: Mapping[str, Any]) -> None:
+        """Mirror a ``chaos.netfaults.LinkFaultPlane.snapshot()`` —
+        optionally carrying a broker ``fencing`` block (the
+        ``fenced_*_total`` counters from ``NetBrokerClient.status()`` /
+        ``InMemoryBroker.producer_fence_stats()``) — into the
+        netfault_* / fenced_* series. Called at exposition time; the
+        links' cumulative effect counts mirror as counter DELTAS against
+        last-seen values (never a negative increment), so a stream-job
+        and a serving app syncing the same snapshot render IDENTICAL
+        series."""
+        for link, entry in (snapshot.get("links") or {}).items():
+            link = str(link)
+            self.netfault_link_active.set(
+                1.0 if entry.get("active") else 0.0, link=link)
+            for field, counter in (
+                    ("windows_begun", self.netfault_windows),
+                    ("delayed_sends_total", self.netfault_delayed_sends),
+                    ("dropped_sends_total", self.netfault_dropped_sends),
+                    ("partitioned_sends_total",
+                     self.netfault_partitioned_sends),
+                    ("lost_responses_total",
+                     self.netfault_lost_responses),
+                    ("throttled_bytes_total",
+                     self.netfault_throttled_bytes)):
+                total = float(entry.get(field, 0))
+                key = (link, field)
+                delta = total - self._netfault_seen.get(key, 0.0)
+                if delta > 0:
+                    counter.inc(delta, link=link)
+                self._netfault_seen[key] = total
+        fencing = snapshot.get("fencing") or {}
+        for field, counter in (
+                ("fenced_produces_total", self.fenced_produce),
+                ("fenced_commits_total", self.fenced_commit)):
+            if field not in fencing:
+                continue
+            total = float(fencing.get(field, 0))
+            key = ("fencing", field)
+            delta = total - self._netfault_seen.get(key, 0.0)
+            if delta > 0:
+                counter.inc(delta)
+            self._netfault_seen[key] = total
 
     def sync_cluster(self, snapshot: Mapping[str, Any]) -> None:
         """Mirror a ``cluster.fleet.WorkerFleet.snapshot()`` (stream

@@ -166,6 +166,12 @@ class ServingApp:
         # function of (workers, n_partitions, virtual_nodes) — every
         # worker and every ingress computes the same answer with no
         # coordination traffic.
+        # optional network-fault snapshot source (an object with
+        # .snapshot(), e.g. chaos.netfaults.LinkFaultPlane): attached by
+        # harnesses/drills that degrade this app's links; exposition
+        # mirrors it through sync_netfaults so the serving plane renders
+        # the same netfault_*/fenced_* series as a stream job would
+        self.netfaults = None
         self.cluster_router = None
         cl = self.config.cluster
         if cl.enabled:
@@ -647,6 +653,8 @@ class ServingApp:
             self.metrics.sync_feedback(snap)
         if self.cluster_router is not None:
             self.metrics.sync_cluster(self._cluster_snapshot())
+        if self.netfaults is not None:
+            self.metrics.sync_netfaults(self.netfaults.snapshot())
         return 200, self.metrics.render_prometheus()
 
     def _cluster_snapshot(self) -> Dict[str, Any]:

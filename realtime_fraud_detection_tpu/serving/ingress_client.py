@@ -140,8 +140,20 @@ class ShardIngressClient:
                 url = self._next_url()
                 continue
             if status == 421:
+                # a 421 is PROOF the asked worker does not own this user
+                # — invalidate any learned affinity pointing there FIRST,
+                # even when the redirect cannot be followed: mid-
+                # rebalance, a previously-confirmed mapping is exactly
+                # the entry most likely to be stale, and keeping it
+                # would re-route every later request for this user into
+                # the same refusal
+                if self._affinity.get(uid) == url:
+                    self._affinity.pop(uid, None)
                 location = str((body or {}).get("location") or "")
                 if not location or redirects >= self.max_redirects:
+                    # bounded-redirect guard: two workers with divergent
+                    # membership views can bounce a key back and forth —
+                    # terminate with an explicit error, never a loop
                     raise NoShardAvailableError(
                         f"wrong shard for user {uid!r} and no followable "
                         f"location after {redirects} redirects "

@@ -59,6 +59,19 @@ tail either; the residual window is a crash between a failing produce's
 WAL fsync and its pin write (the same compromise as Kafka's checkpointed
 HW). Pinned by the regression tests in tests/test_netbroker.py.
 
+Producer generation fencing (the zombie-writer story, ISSUE 13): the
+cluster coordinator's rebalance fence step calls ``fence_producers`` for
+every moved partition at the new assignment generation; workers stamp
+their produces/commits with the generation they last adopted
+(``NetBrokerClient.generation``), and a stamped write below a
+partition's fence is refused whole-frame with ``StaleGenerationError``
+(counted; unstamped external producers pass). This closes the asymmetric
+partition: a worker that cannot hear the coordinator but still reaches
+the broker is fenced at the WRITE seam, not just the checkpoint seam
+(cluster/handoff.py's offset-epoch fence) — Kafka's zombie-producer
+epoch fencing, in-house. Fences forward to replicas like commits, so a
+promoted replica keeps refusing the same zombies.
+
 The wire format is 4-byte big-endian length + JSON — deliberately boring:
 the contract (offsets, groups, keyed partitions, commit-after-fanout) is
 what's load-bearing, and the contract tests run identically against
@@ -83,9 +96,11 @@ from realtime_fraud_detection_tpu.stream.transport import (
     FaultInjector,
     InMemoryBroker,
     Record,
+    StaleGenerationError,
 )
 
-__all__ = ["BrokerServer", "NetBrokerClient", "HaBrokerClient"]
+__all__ = ["BrokerServer", "NetBrokerClient", "HaBrokerClient",
+           "StaleGenerationError"]
 
 _LEN = struct.Struct(">I")
 _MAX_FRAME = 64 * 1024 * 1024
@@ -96,9 +111,23 @@ def _send_frame(sock: socket.socket, obj: Any) -> None:
     sock.sendall(_LEN.pack(len(payload)) + payload)
 
 
-def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+def _recv_exact(sock: socket.socket, n: int,
+                deadline: Optional[float] = None) -> Optional[bytes]:
+    """Read exactly ``n`` bytes. With ``deadline`` (an absolute monotonic
+    instant) the WHOLE read is bounded — a hung-not-dead peer (SIGSTOP'd
+    broker, stalled middlebox) trickling one byte per socket-timeout
+    window would otherwise reset the per-recv timeout forever and wedge
+    the caller; here every chunk shrinks the remaining budget."""
     buf = bytearray()
     while len(buf) < n:
+        if deadline is not None:
+            # rtfd-lint: allow[wall-clock] socket I/O deadlines are genuinely wall-bound
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(
+                    f"frame read deadline exceeded with {n - len(buf)} "
+                    f"bytes outstanding")
+            sock.settimeout(remaining)
         chunk = sock.recv(n - len(buf))
         if not chunk:
             return None
@@ -106,14 +135,15 @@ def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
     return bytes(buf)
 
 
-def _recv_frame(sock: socket.socket) -> Optional[Any]:
-    header = _recv_exact(sock, _LEN.size)
+def _recv_frame(sock: socket.socket,
+                deadline: Optional[float] = None) -> Optional[Any]:
+    header = _recv_exact(sock, _LEN.size, deadline)
     if header is None:
         return None
     (length,) = _LEN.unpack(header)
     if length > _MAX_FRAME:
         raise ValueError(f"frame of {length} bytes exceeds limit")
-    payload = _recv_exact(sock, length)
+    payload = _recv_exact(sock, length, deadline)
     if payload is None:
         return None
     return json.loads(payload)
@@ -274,7 +304,8 @@ class BrokerServer:
             self._seg_files[key] = f
         return f
 
-    def _produce(self, topic: str, items: List[tuple]) -> List[Record]:
+    def _produce(self, topic: str, items: List[tuple],
+                 generation: Optional[int] = None) -> List[Record]:
         """Produce with WAL-first durability + synchronous replication:
         partition is chosen, the WAL line is written + fsync'd, the record
         is published to the in-memory log, and it is shipped to every
@@ -284,6 +315,12 @@ class BrokerServer:
         produces so WAL line order always matches log offset order per
         partition AND replicas receive offsets contiguously.
         ``items``: [(key, value, timestamp|None)].
+
+        A stamped ``generation`` is fence-checked for EVERY target
+        partition BEFORE the WAL write — a refused frame is all-or-
+        nothing (no partial batch, no invisible above-watermark residue),
+        so a zombie writer's whole fan-out bounces with
+        ``StaleGenerationError`` and nothing it wrote can surface later.
         """
         b = self.broker
         with self._io_lock:
@@ -293,6 +330,9 @@ class BrokerServer:
                  ts if ts is not None else time.time())
                 for k, v, ts in items
             ]
+            if generation is not None:
+                for part in sorted({p for p, _k, _v, _ts in planned}):
+                    b.check_producer_generation(topic, part, generation)
             if self.log_dir is not None:
                 touched = set()
                 for part, k, v, ts in planned:
@@ -685,17 +725,42 @@ class BrokerServer:
             return {"role": self.role}
         if op == "status":
             return {"role": self.role, "min_isr": self.min_isr,
-                    "isr": self.isr_size()}
+                    "isr": self.isr_size(),
+                    **self.broker.producer_fence_stats()}
+        if op == "fence_producers":
+            # the coordinator's rebalance fence step: stamped writes to
+            # these partitions below `generation` are refused from now
+            # on. Forwarded to replicas like offset commits, so a
+            # promoted replica keeps fencing the same zombies.
+            self.broker.fence_producers(req["topic"], req["partitions"],
+                                        int(req["generation"]))
+            with self._io_lock:
+                alive = []
+                for link in self._replicas:
+                    try:
+                        link.call({"op": "fence_producers",
+                                   "topic": req["topic"],
+                                   "partitions": req["partitions"],
+                                   "generation": int(req["generation"])})
+                        alive.append(link)
+                    except Exception:  # noqa: BLE001 — ISR shrink policy
+                        link.close()
+                self._replicas[:] = alive
+            return {}
         if op == "produce":
+            gen = req.get("gen")
             rec = self._produce(req["topic"], [(
-                req.get("key"), req["value"], req.get("timestamp"))])[0]
+                req.get("key"), req["value"], req.get("timestamp"))],
+                generation=int(gen) if gen is not None else None)[0]
             return {"partition": rec.partition, "offset": rec.offset}
         if op == "produce_batch":
             # optional per-record "ts": drills stamp virtual arrival times
             # so consumer-side budget/latency math shares one time base
+            gen = req.get("gen")
             recs = self._produce(req["topic"], [
                 (item.get("k"), item["v"], item.get("ts"))
-                for item in req["records"]])
+                for item in req["records"]],
+                generation=int(gen) if gen is not None else None)
             return {"n": len(recs)}
         if op == "fetch":
             # reads stop at the high watermark: a record above it exists on
@@ -713,7 +778,9 @@ class BrokerServer:
             for key, off in req["offsets"].items():
                 t, _, p = key.rpartition(":")
                 offsets[(t, int(p))] = int(off)
-            b.commit(req["group"], offsets)
+            gen = req.get("gen")
+            b.commit(req["group"], offsets,
+                     generation=int(gen) if gen is not None else None)
             self._persist_offsets()
             self._forward_commit(req["group"], req["offsets"])
             return {}
@@ -785,7 +852,7 @@ class NetBrokerClient:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 9092,
                  timeout_s: float = 30.0, reconnect_attempts: int = 5,
-                 retry_sleep=None):
+                 retry_sleep=None, link=None):
         from realtime_fraud_detection_tpu.utils.backoff import (
             DeterministicBackoff,
             instance_seed,
@@ -798,6 +865,16 @@ class NetBrokerClient:
         self._lock = threading.Lock()
         self._part_cache: Dict[str, int] = {}
         self._reconnect_attempts = max(0, int(reconnect_attempts))
+        # optional in-path chaos link (chaos/netfaults.py): consulted
+        # before every send and after every recv — latency/throttle
+        # sleeps, partition/drop connection errors. None in production.
+        self._link = link
+        # optional producer assignment generation: when set, every
+        # produce/commit frame is stamped with it and the broker refuses
+        # the write if the target partition was fenced at a newer
+        # generation (StaleGenerationError — the zombie-writer fence).
+        # The cluster worker sets this each time it adopts an assignment.
+        self.generation: Optional[int] = None
         # monotonically increasing reconnect epoch: EVERY consumer sharing
         # this client compares its last-seen epoch and rewinds to committed
         # offsets when it observes a newer one (a read-and-clear flag would
@@ -841,12 +918,40 @@ class NetBrokerClient:
         resp = None
         last: Optional[Exception] = None
         for attempt in range(self._reconnect_attempts + 1):
+            resp = None
             try:
                 with self._lock:
+                    if self._link is not None:
+                        # frame size rides along so slow-link throttling
+                        # can pace by bytes (the double serialization is
+                        # paid only while a chaos link is attached)
+                        self._link.before_send(
+                            req, len(json.dumps(
+                                req, separators=(",", ":")).encode()))
                     _send_frame(self._sock, req)
-                    resp = _recv_frame(self._sock)
+                    # absolute per-op deadline: a hung-not-dead broker
+                    # (SIGSTOP, stalled VM) trickling bytes cannot reset
+                    # the budget — the whole frame read is bounded
+                    deadline = time.monotonic() + self._timeout_s  # rtfd-lint: allow[wall-clock] socket I/O deadline is genuinely wall-bound
+                    try:
+                        resp = _recv_frame(self._sock, deadline=deadline)
+                    finally:
+                        # the deadline path shrinks the socket timeout to
+                        # the residual budget; restore the full op
+                        # timeout so the NEXT call's sendall never runs
+                        # under a near-zero leftover
+                        try:
+                            self._sock.settimeout(self._timeout_s)
+                        except OSError:
+                            pass
                 if resp is None:
                     raise ConnectionError("broker closed the connection")
+                if self._link is not None:
+                    # one-way partition: the op was APPLIED broker-side
+                    # but the ack is lost — surfaces as a connection
+                    # error, so a retried produce may duplicate
+                    # (at-least-once; consumers dedupe by txn id)
+                    self._link.after_recv(req)
                 break
             except (ConnectionError, OSError) as e:
                 last = e
@@ -861,14 +966,23 @@ class NetBrokerClient:
         if resp is None:
             raise ConnectionError(f"broker unreachable: {last}")
         if "error" in resp:
-            raise RuntimeError(f"broker error: {resp['error']}")
+            msg = str(resp["error"])
+            if msg.startswith("StaleGenerationError"):
+                raise StaleGenerationError(f"broker refused: {msg}")
+            raise RuntimeError(f"broker error: {msg}")
         return resp
+
+    def _stamp_gen(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        if self.generation is not None:
+            req["gen"] = int(self.generation)
+        return req
 
     # ------------------------------------------------------------- produce
     def produce(self, topic: str, value: Any, key: Optional[str] = None,
                 timestamp: Optional[float] = None) -> Record:
-        r = self._call({"op": "produce", "topic": topic, "value": value,
-                        "key": key, "timestamp": timestamp})
+        r = self._call(self._stamp_gen(
+            {"op": "produce", "topic": topic, "value": value,
+             "key": key, "timestamp": timestamp}))
         return Record(topic, r["partition"], r["offset"], key, value,
                       timestamp or 0.0)
 
@@ -876,8 +990,9 @@ class NetBrokerClient:
         items = [{"v": v, "k": key_fn(v) if key_fn else None} for v in values]
         if not items:
             return 0
-        return self._call({"op": "produce_batch", "topic": topic,
-                           "records": items})["n"]
+        return self._call(self._stamp_gen(
+            {"op": "produce_batch", "topic": topic,
+             "records": items}))["n"]
 
     def produce_batch_keyed(self, topic: str, items) -> int:
         """(key, value) pairs in ONE frame — the fan-out hot path
@@ -885,8 +1000,9 @@ class NetBrokerClient:
         records = [{"v": v, "k": k} for k, v in items]
         if not records:
             return 0
-        return self._call({"op": "produce_batch", "topic": topic,
-                           "records": records})["n"]
+        return self._call(self._stamp_gen(
+            {"op": "produce_batch", "topic": topic,
+             "records": records}))["n"]
 
     def produce_batch_stamped(self, topic: str, items) -> int:
         """(key, value, timestamp) triples in ONE frame — the drill/replay
@@ -895,8 +1011,17 @@ class NetBrokerClient:
         records = [{"v": v, "k": k, "ts": ts} for k, v, ts in items]
         if not records:
             return 0
-        return self._call({"op": "produce_batch", "topic": topic,
-                           "records": records})["n"]
+        return self._call(self._stamp_gen(
+            {"op": "produce_batch", "topic": topic,
+             "records": records}))["n"]
+
+    def fence_producers(self, topic: str, partitions, generation: int,
+                        ) -> None:
+        """Coordinator op: refuse stamped writes below ``generation`` for
+        these partitions (the rebalance fence step's write-seam half)."""
+        self._call({"op": "fence_producers", "topic": topic,
+                    "partitions": [int(p) for p in partitions],
+                    "generation": int(generation)})
 
     # ------------------------------------------------------------- consume
     def consumer(self, topics: Sequence[str], group_id: str,
@@ -928,7 +1053,8 @@ class NetBrokerClient:
 
     def commit(self, group: str, offsets: Mapping[tuple, int]) -> None:
         wire = {f"{t}:{p}": off for (t, p), off in offsets.items()}
-        self._call({"op": "commit", "group": group, "offsets": wire})
+        self._call(self._stamp_gen(
+            {"op": "commit", "group": group, "offsets": wire}))
 
     def partitions(self, topic: str) -> int:
         n = self._part_cache.get(topic)

@@ -44,6 +44,17 @@ class Record:
     timestamp: float
 
 
+class StaleGenerationError(RuntimeError):
+    """A generation-stamped produce/commit hit a partition fenced at a
+    NEWER assignment generation: the writer lost ownership in a rebalance
+    it has not observed yet — the classic zombie of an asymmetric
+    partition (deaf to the coordinator, still reaching the broker). The
+    write is refused loudly at the broker, the same way Kafka's producer
+    epoch fences a zombie transactional producer; unstamped producers
+    (external feeds that never participate in assignment) are unaffected.
+    """
+
+
 @dataclasses.dataclass
 class FaultInjector:
     """Deterministic transport fault injection (absent in the reference —
@@ -94,6 +105,14 @@ class InMemoryBroker:
         self._rr: Dict[str, int] = {}            # round-robin cursor per topic
         self._lock = threading.Lock()
         self._auto_partitions = auto_create_partitions
+        # producer generation fences: (topic, partition) -> minimum
+        # assignment generation a STAMPED produce/commit must carry. The
+        # cluster coordinator bumps these in its rebalance fence step so
+        # a partitioned-away worker is fenced at the WRITE seam, not just
+        # the checkpoint seam (see StaleGenerationError).
+        self._gen_fence: Dict[tuple, int] = {}
+        self.fenced_produces = 0
+        self.fenced_commits = 0
         for t in topics:
             self.create_topic(t.name, t.partitions)
 
@@ -144,10 +163,57 @@ class InMemoryBroker:
         return rec
 
     def produce(self, topic: str, value: Any, key: Optional[str] = None,
-                timestamp: Optional[float] = None) -> Record:
-        """Append one record; partition chosen by key hash."""
-        return self.append(topic, self.select_partition(topic, key), value,
-                           key, timestamp)
+                timestamp: Optional[float] = None,
+                generation: Optional[int] = None) -> Record:
+        """Append one record; partition chosen by key hash. A stamped
+        ``generation`` is checked against the partition's producer fence
+        (unstamped produces pass — generation fencing is opt-in, like
+        Kafka's producer epochs)."""
+        part = self.select_partition(topic, key)
+        self.check_producer_generation(topic, part, generation)
+        return self.append(topic, part, value, key, timestamp)
+
+    # ------------------------------------------------ generation fencing
+    def fence_producers(self, topic: str, partitions: Sequence[int],
+                        generation: int) -> None:
+        """Refuse future STAMPED produces/commits for these partitions
+        whose generation is older than ``generation`` (monotonic: a fence
+        never moves backwards)."""
+        with self._lock:
+            for p in partitions:
+                key = (topic, int(p))
+                if int(generation) > self._gen_fence.get(key, 0):
+                    self._gen_fence[key] = int(generation)
+
+    def producer_fence(self, topic: str, partition: int) -> int:
+        return self._gen_fence.get((topic, int(partition)), 0)
+
+    def check_producer_generation(self, topic: str, partition: int,
+                                  generation: Optional[int],
+                                  op: str = "produce") -> None:
+        """Raise :class:`StaleGenerationError` when a stamped write hits
+        a newer fence. ``None`` (unstamped) always passes."""
+        if generation is None:
+            return
+        fence = self._gen_fence.get((topic, int(partition)))
+        if fence is not None and int(generation) < fence:
+            with self._lock:
+                if op == "commit":
+                    self.fenced_commits += 1
+                else:
+                    self.fenced_produces += 1
+            raise StaleGenerationError(
+                f"{op} to {topic}-{partition} at generation {generation} "
+                f"refused: partition fenced at generation {fence} "
+                f"(writer lost ownership in an unobserved rebalance)")
+
+    def producer_fence_stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "fenced_produces": self.fenced_produces,
+                "fenced_commits": self.fenced_commits,
+                "fenced_partitions": len(self._gen_fence),
+            }
 
     def produce_batch(self, topic: str, values: Iterable[Any],
                       key_fn: Optional[Callable[[Any], str]] = None) -> int:
@@ -205,7 +271,16 @@ class InMemoryBroker:
     def committed(self, group: str, topic: str, partition: int) -> int:
         return self._committed.get((group, topic, partition), 0)
 
-    def commit(self, group: str, offsets: Mapping[tuple, int]) -> None:
+    def commit(self, group: str, offsets: Mapping[tuple, int],
+               generation: Optional[int] = None) -> None:
+        # a stamped commit is fence-checked for EVERY partition BEFORE
+        # any offset is applied: a zombie's commit must not advance the
+        # group past records whose predictions were refused at the
+        # produce fence (that would silently lose them)
+        if generation is not None:
+            for (topic, part) in offsets:
+                self.check_producer_generation(topic, part, generation,
+                                               op="commit")
         with self._lock:
             for (topic, part), off in offsets.items():
                 key = (group, topic, part)

@@ -369,6 +369,73 @@ class TestShardIngressClient:
         assert len(slept) == 3          # the deterministic backoff seam
         assert cli.snapshot()["retried"] == 3
 
+    def test_stale_ring_pingpong_terminates_explicitly(self):
+        """ISSUE 13 satellite: when BOTH the learned affinity and the
+        serving ring are stale mid-rebalance, two workers can bounce a
+        key back and forth forever — the bounded-redirect guard must
+        terminate with an explicit error, never loop."""
+        from realtime_fraud_detection_tpu.serving.ingress_client import (
+            NoShardAvailableError,
+            ShardIngressClient,
+        )
+
+        urls = ["http://a", "http://b"]
+        cli = ShardIngressClient(urls, max_redirects=3,
+                                 retry_sleep=lambda s: None)
+        posts = []
+
+        def _pingpong(url, payload):
+            posts.append(url)
+            other = urls[1] if url == urls[0] else urls[0]
+            return 421, {"owner": "elsewhere", "location": other}
+
+        cli._post = _pingpong
+        with pytest.raises(NoShardAvailableError):
+            cli.predict({"transaction_id": "t1", "user_id": "u9",
+                         "merchant_id": "m1", "amount": 1.0})
+        # initial attempt + exactly max_redirects follows — bounded
+        assert len(posts) == 1 + 3
+        assert cli.snapshot()["redirects_followed"] == 3
+        # the ping-pong left NO poisoned affinity behind: the last 421
+        # invalidated the entry the previous redirect had learned
+        assert cli.snapshot()["affinity_size"] == 0
+
+    def test_affinity_invalidated_on_421_for_confirmed_user(self):
+        """A previously-CONFIRMED user→worker mapping that starts
+        answering 421 (its partition moved) is dropped from the learned
+        affinity even when the redirect cannot be followed — the next
+        request must not re-route into the same refusal."""
+        from realtime_fraud_detection_tpu.serving.ingress_client import (
+            NoShardAvailableError,
+            ShardIngressClient,
+        )
+
+        cli = ShardIngressClient(["http://a", "http://b"],
+                                 retry_sleep=lambda s: None)
+        script = {"phase": "confirm"}
+
+        def _post(url, payload):
+            if script["phase"] == "confirm":
+                return 200, {"transaction_id": "t", "fraud_score": 0.1}
+            # moved: the old owner refuses and (mid-rebalance) cannot
+            # even name a successor yet
+            if url == script["stale_url"]:
+                return 421, {"owner": None, "location": ""}
+            return 200, {"transaction_id": "t", "fraud_score": 0.2}
+
+        cli._post = _post
+        txn = {"transaction_id": "t", "user_id": "u1",
+               "merchant_id": "m", "amount": 1.0}
+        cli.predict(txn)                        # learns the affinity
+        stale_url = cli._affinity["u1"]
+        script.update(phase="moved", stale_url=stale_url)
+        with pytest.raises(NoShardAvailableError):
+            cli.predict(txn)                    # 421, no location
+        assert "u1" not in cli._affinity        # poisoned entry dropped
+        body = cli.predict(txn)                 # rotates to a live worker
+        assert body["fraud_score"] == 0.2
+        assert cli._affinity["u1"] != stale_url
+
     def test_follows_421_to_owner_and_learns_affinity(self):
         """Two live cluster-mode serving apps: a request for a user the
         second worker owns, sent to the first, follows the 421 to the
@@ -623,7 +690,8 @@ class TestElasticSettingsAndScopes:
         )
 
         assert "elastic-drill" in LOCKWATCH_DRILLS
-        assert len(LOCKWATCH_DRILLS) == 9
+        # ten since ISSUE 13 added partition-drill
+        assert len(LOCKWATCH_DRILLS) == 10
 
     def test_compact_summary_under_2kb_even_when_bloated(self):
         from realtime_fraud_detection_tpu.cluster.elastic_drill import (

@@ -625,3 +625,108 @@ class TestReplicationStress:
             consumer_client.close()
             primary.stop()
             replica.stop()
+
+
+class TestSocketHangHardening:
+    """ISSUE 13 satellite: every blocking client read carries a deadline,
+    so a hung-not-dead peer can never wedge a worker forever."""
+
+    BROKER_CHILD = (
+        "import signal\n"
+        "from realtime_fraud_detection_tpu.stream.netbroker import "
+        "BrokerServer\n"
+        "srv = BrokerServer(port=0).start()\n"
+        "print(srv.port, flush=True)\n"
+        "signal.pause()\n"
+    )
+
+    def test_sigstop_broker_bounded_error_then_resume_on_sigcont(self):
+        """SIGSTOP a REAL broker process: the client errors out within
+        the deadline x retry budget (recording its DeterministicBackoff
+        sleeps on the way), then resumes cleanly on SIGCONT."""
+        import time as _time
+
+        proc = subprocess.Popen(
+            [sys.executable, "-c", self.BROKER_CHILD],
+            stdout=subprocess.PIPE, text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        try:
+            port = int(proc.stdout.readline())
+            cli = NetBrokerClient(port=port, timeout_s=1.0,
+                                  reconnect_attempts=2,
+                                  retry_sleep=lambda s: None)
+            cli.produce(T.TRANSACTIONS, {"v": 0}, key="k")   # healthy
+            os.kill(proc.pid, signal.SIGSTOP)
+            t0 = _time.monotonic()
+            with pytest.raises((ConnectionError, OSError)):
+                # a stopped process still completes TCP handshakes (the
+                # kernel backlog accepts), so every retry reconnects
+                # "successfully" and then times out on the frame read —
+                # the absolute deadline bounds each attempt
+                cli.produce(T.TRANSACTIONS, {"v": 1}, key="k")
+            elapsed = _time.monotonic() - t0
+            # 3 attempts x 1.0 s deadline + slack (backoff sleeps are
+            # recorded, not slept)
+            assert elapsed < 8.0, f"wedged for {elapsed:.1f}s"
+            assert len(cli._backoff.slept) >= 1, \
+                "client never entered its DeterministicBackoff"
+            os.kill(proc.pid, signal.SIGCONT)
+            deadline = _time.monotonic() + 15
+            while True:
+                try:
+                    cli.produce(T.TRANSACTIONS, {"v": 2}, key="k")
+                    break
+                except (ConnectionError, OSError):
+                    if _time.monotonic() > deadline:
+                        raise
+            cli.close()
+        finally:
+            proc.kill()
+            proc.wait(timeout=10)
+
+    def test_trickling_peer_hits_absolute_deadline(self):
+        """A peer that trickles bytes slower than the frame but faster
+        than the per-recv timeout used to reset the clock forever; the
+        absolute whole-frame deadline bounds it."""
+        import socket as _socket
+        import threading as _threading
+        import time as _time
+
+        srv = _socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        port = srv.getsockname()[1]
+        stop = _threading.Event()
+
+        def _trickle():
+            conn, _ = srv.accept()
+            try:
+                conn.recv(65536)                     # swallow the request
+                # claim a 1000-byte frame, then trickle 1 byte / 0.25 s —
+                # each byte lands well inside a naive per-recv timeout
+                conn.sendall((1000).to_bytes(4, "big"))
+                while not stop.is_set():
+                    try:
+                        conn.sendall(b"x")
+                    except OSError:
+                        return
+                    _time.sleep(0.25)
+            finally:
+                conn.close()
+
+        t = _threading.Thread(target=_trickle, daemon=True)
+        t.start()
+        try:
+            cli = NetBrokerClient(port=port, timeout_s=1.0,
+                                  reconnect_attempts=0,
+                                  retry_sleep=lambda s: None)
+            t0 = _time.monotonic()
+            with pytest.raises((ConnectionError, OSError)):
+                cli.ping()
+            elapsed = _time.monotonic() - t0
+            assert elapsed < 4.0, \
+                f"trickling peer held the client {elapsed:.1f}s"
+            cli.close()
+        finally:
+            stop.set()
+            srv.close()
