@@ -226,6 +226,22 @@ def _compact_summary(result: dict) -> dict:
             "fenced_produces": dn.get("fenced_produces"),
         } if (dn := result.get("degraded_network") or {})
             and not dn.get("error") else None),
+        "graph_sampling": ({
+            "sampler_cold_us_per_txn": (gs.get("micro") or {}).get(
+                "sampler_cold_us_per_txn"),
+            "sampler_cached_us_per_txn": (gs.get("micro") or {}).get(
+                "sampler_cached_us_per_txn"),
+            "remote_batch_amortization": (gs.get("micro") or {}).get(
+                "remote_batch_amortization"),
+            "ring_phase_lift": (gs.get("drill") or {}).get(
+                "ring_phase_lift"),
+            "ring_auc_graph_on": (gs.get("drill") or {}).get(
+                "ring_auc_graph_on"),
+            "ring_auc_incumbent": (gs.get("drill") or {}).get(
+                "ring_auc_incumbent"),
+            "passed": (gs.get("drill") or {}).get("passed"),
+        } if (gs := result.get("graph_sampling") or {})
+            and not gs.get("error") else None),
         "shard_scaling": ({
             "single_worker_txn_per_s": sh.get("single_worker_txn_per_s"),
             "aggregate_txn_per_s": sh.get("aggregate_txn_per_s"),
@@ -287,7 +303,8 @@ def _compact_summary(result: dict) -> dict:
         for victim in ("configs_txn_per_s", "operating_point", "quality",
                        "host_assembly", "mesh_scaling", "pool_scaling",
                        "autotune", "chaos", "degraded_network",
-                       "shard_scaling", "elastic_scaling", "quantization",
+                       "graph_sampling", "shard_scaling",
+                       "elastic_scaling", "quantization",
                        "latest_committed_tpu_capture",
                        "text_encoder", "error"):
             if compact.pop(victim, None) is not None:
@@ -1056,6 +1073,22 @@ def run_bench() -> None:
                 "error": f"{type(e).__name__}: {e}"[:200]}
         _log(f'degraded-network stage done: '
              f'{ {k: v for k, v in (result.get("degraded_network") or {}).items() if not isinstance(v, dict)} }')
+
+    # ----------------------------------------------- graph-sampling stage
+    # Entity-graph plane (graph/): typed-sampler µs/txn cold vs cached +
+    # remote-fetch amortization in-process, plus a fast no-replay
+    # graph-drill subprocess reporting the ring-phase AUC lift of the
+    # graph-on blend vs the trees-only incumbent. The drill subprocess is
+    # pinned to the CPU platform — safe on any box including a tunneled
+    # TPU session.
+    if remaining() > 90:
+        try:
+            _graph_sampling_stage(result, snapshot)
+        except Exception as e:  # noqa: BLE001
+            result["graph_sampling"] = {
+                "error": f"{type(e).__name__}: {e}"[:200]}
+        _log(f'graph-sampling stage done: '
+             f'{ {k: v for k, v in ((result.get("graph_sampling") or {}).get("drill") or {}).items() if not isinstance(v, dict)} }')
 
     # ------------------------------------------------ shard-scaling stage
     # Partition-parallel worker plane (cluster/): aggregate virtual txn/s
@@ -1910,6 +1943,63 @@ def _degraded_network_stage(result: dict, snapshot) -> None:
         "scored_duplicates": full.get("scored_duplicates"),
     }
     snapshot("degraded_network")
+
+
+def _graph_sampling_stage(result: dict, snapshot) -> None:
+    """Entity-graph plane (ISSUE 14 bench satellite). Two halves:
+
+    (1) in-process micro numbers (graph.drill.run_graph_sampling_bench):
+    per-txn typed-sampler cost cold vs cached on a seeded synthetic
+    graph, and remote-fetch amortization (per-node requests vs one
+    batched request) against a live local TCP fetch server — pure host
+    work, safe anywhere including a tunneled TPU session;
+
+    (2) one fast, no-replay pass of ``rtfd graph-drill`` in a CPU-pinned
+    subprocess, reporting the ring-phase AUC lift of the graph-on blend
+    over the trees-only incumbent plus the fetch/degrade headline
+    counters. The pass/fail bar lives in ``rtfd graph-drill`` and the
+    tier-1 smoke."""
+    from realtime_fraud_detection_tpu.graph.drill import (
+        run_graph_sampling_bench,
+    )
+
+    stage: dict = {"micro": run_graph_sampling_bench()}
+    argv = [sys.executable, "-m", "realtime_fraud_detection_tpu",
+            "graph-drill", "--fast", "--no-replay"]
+    proc = subprocess.run(argv, capture_output=True, text=True, timeout=600,
+                          env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    full: dict = {}
+    for line in reversed(proc.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                parsed = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if "auc" in parsed and "graph" in parsed:  # the FULL result
+                full = parsed                          # (final = verdict)
+                break
+    if not full:
+        raise RuntimeError(
+            f"graph-drill produced no parseable result "
+            f"(rc={proc.returncode}): {(proc.stderr or '')[-200:]}")
+    auc = full.get("auc") or {}
+    stage["drill"] = {
+        "passed": bool(full.get("passed")),
+        "failed_checks": sorted(k for k, v in
+                                (full.get("checks") or {}).items() if not v),
+        "ring_phase_lift": auc.get("ring_phase_lift"),
+        "ring_auc_graph_on": (auc.get("ring") or {}).get("graph_on"),
+        "ring_auc_incumbent": (auc.get("ring") or {}).get(
+            "incumbent_trees"),
+        "healthy_auc_graph_on": (auc.get("healthy") or {}).get("graph_on"),
+        "remote_fetches": full.get("remote_fetches"),
+        "remote_nodes": full.get("remote_nodes"),
+        "degraded_in_window": full.get("degraded_in_window"),
+        "ring_workers": full.get("ring_workers"),
+    }
+    result["graph_sampling"] = stage
+    snapshot("graph_sampling")
 
 
 def _shard_scaling_stage(result: dict, snapshot) -> None:

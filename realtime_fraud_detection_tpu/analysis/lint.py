@@ -90,7 +90,7 @@ PACKAGE_NAME = "realtime_fraud_detection_tpu"
 # process pacing carries justified pragmas like elastic_drill.)
 CLOCK_SUBSYSTEMS = frozenset(
     {"qos", "tuning", "feedback", "obs", "stream", "serving", "scoring",
-     "sim", "cluster", "chaos"})
+     "sim", "cluster", "chaos", "graph"})
 
 # Whole modules under the pre-pull-safe / dispatch-path d2h contract
 # (utils/timing.py rule 2: only block_until_ready inside timed sections).
@@ -138,6 +138,11 @@ DETERMINISM_MODULES = frozenset({
 # seeds/inputs, or `rtfd shard-drill`'s bit-identical second run lies.
 DETERMINISM_SUBSYSTEMS = frozenset({
     "cluster",
+    # entity-graph plane (ISSUE 14): the typed store rides PartitionState
+    # handoff blobs and the sampler/fetch results feed score content —
+    # graph-drill's digest-identical fresh second run requires every
+    # module to be a pure function of its inputs (seeded rng only)
+    "graph",
 })
 
 # Param / degradation-mask mutators: reachable only under the score lock

@@ -4,7 +4,8 @@ The static ``lock-order`` rule (analysis/lint.py) sees the lexical
 structure; this module watches what the threads actually do. While any of
 the deterministic drills run (``rtfd lint --lockwatch`` drives pool-drill,
 trace-drill, autotune-drill, feedback-drill, qos-drill, chaos-drill,
-shard-drill, mesh-drill, elastic-drill and partition-drill), every
+shard-drill, mesh-drill, elastic-drill, partition-drill and
+graph-drill), every
 ``threading.Lock`` / ``RLock`` / ``Condition`` created from package code
 is replaced by an instrumented wrapper that records, per thread:
 
@@ -45,11 +46,11 @@ _REAL_CONDITION = threading.Condition
 
 PACKAGE_MARKER = "realtime_fraud_detection_tpu"
 
-# the ten deterministic drills the watcher is validated against
+# the eleven deterministic drills the watcher is validated against
 LOCKWATCH_DRILLS = ("qos-drill", "trace-drill", "autotune-drill",
                     "feedback-drill", "pool-drill", "chaos-drill",
                     "shard-drill", "mesh-drill", "elastic-drill",
-                    "partition-drill")
+                    "partition-drill", "graph-drill")
 
 
 class LockWatcher:
@@ -497,7 +498,7 @@ def run_drill_watched(drill: str, fast: bool = True,
                     else ElasticDrillConfig(),
                     replay_check=False)
                 passed = bool(run_elastic_drill(cfg)["passed"])
-            else:   # partition-drill
+            elif drill == "partition-drill":
                 import dataclasses
 
                 from realtime_fraud_detection_tpu.chaos.partition_drill import (
@@ -515,4 +516,22 @@ def run_drill_watched(drill: str, fast: bool = True,
                     else PartitionDrillConfig(),
                     replay_check=False)
                 passed = bool(run_partition_drill(cfg)["passed"])
+            else:   # graph-drill
+                import dataclasses
+
+                from realtime_fraud_detection_tpu.graph.drill import (
+                    GraphDrillConfig,
+                    run_graph_drill,
+                )
+
+                # single pass (the fresh-run digest is the drill's own
+                # acceptance); the watcher instruments everything here —
+                # the in-process worker fleet, the typed graph stores'
+                # internal locks, AND the graph-fetch TCP server threads
+                # reading live stores while the drive loop ingests
+                cfg = dataclasses.replace(
+                    GraphDrillConfig.fast() if fast
+                    else GraphDrillConfig(),
+                    replay_check=False)
+                passed = bool(run_graph_drill(cfg)["passed"])
     return {"drill": drill, "drill_passed": passed, "lockwatch": w.report()}

@@ -121,6 +121,26 @@ def _derive_quant_mode(params: Any) -> Optional[Dict[str, str]]:
             else "f32"}
 
 
+def _derive_graph_mode(params: Any) -> Optional[Dict[str, str]]:
+    """Auto-derive the GNN graph-mode stamp from a ScoringModels pytree.
+
+    ``typed`` = the heterogeneous entity-graph layout (per-node-type
+    projection weights, graph/ plane) vs ``bipartite`` = the original
+    user↔merchant GraphSAGE. The two forms are different programs over
+    different sampled tensors, so a silent cross-mode restore would
+    change served scores — restore refuses it without
+    ``allow_arch_mismatch``, exactly like the quant stamp."""
+    if not hasattr(params, "gnn"):
+        return None
+    from realtime_fraud_detection_tpu.models.gnn import is_typed_gnn
+
+    try:
+        typed = is_typed_gnn(params.gnn)
+    except TypeError:
+        return None
+    return {"gnn_nodes": "typed" if typed else "bipartite"}
+
+
 @dataclasses.dataclass
 class Checkpoint:
     step: int
@@ -191,6 +211,9 @@ class CheckpointManager:
         quant_mode = meta.get("quant_mode")
         if params is not None and quant_mode is None:
             quant_mode = _derive_quant_mode(params)
+        graph_mode = meta.get("graph_mode")
+        if params is not None and graph_mode is None:
+            graph_mode = _derive_graph_mode(params)
         manifest = {
             "step": step,
             "wall_time": time.time(),
@@ -200,6 +223,7 @@ class CheckpointManager:
             "metadata": meta or None,
             "model_shapes": shapes,
             "quant_mode": quant_mode,
+            "graph_mode": graph_mode,
         }
         with open(d / _MANIFEST, "w") as f:
             json.dump(manifest, f, indent=1)
@@ -249,6 +273,7 @@ class CheckpointManager:
         meta = manifest.get("metadata") or {}
         shapes = manifest.get("model_shapes") or meta.get("model_shapes") or {}
         quant_mode = manifest.get("quant_mode") or {}
+        graph_mode = manifest.get("graph_mode") or {}
         want = {
             "bert_hidden": None if bert_config is None
             else bert_config.hidden_size,
@@ -270,7 +295,12 @@ class CheckpointManager:
             jax.random.PRNGKey(0),
             bert_config=bert_config if bert_config is not None else TINY_CONFIG,
             feature_dim=feature_dim, node_dim=node_dim,
-            n_trees=int(n_trees), tree_depth=int(tree_depth))
+            n_trees=int(n_trees), tree_depth=int(tree_depth),
+            # the SAVED pytree carries the typed per-node-type projection
+            # leaves — orbax's typed restore needs a structurally matching
+            # template (serving permission is restore_into_scorer's
+            # graph-mode arch check, not a template concern)
+            gnn_typed=(graph_mode.get("gnn_nodes") == "typed"))
         if "iforest" in shapes:
             n_if, if_depth = (int(v) for v in shapes["iforest"])
             models = models.replace(iforest=IsolationForest(
@@ -330,6 +360,19 @@ class CheckpointManager:
                 f"configured for {want_mode!r}; restore with a matching "
                 f"quant config or pass allow_arch_mismatch to serve the "
                 f"checkpoint's form anyway")
+        ck_graph = (self.manifest(step).get("graph_mode") or {}).get(
+            "gnn_nodes")
+        sc_graph = getattr(getattr(scorer, "sc", None), "graph_mode", None)
+        want_graph = ({"typed": "typed", "bipartite": "bipartite"}
+                      .get(sc_graph) if sc_graph is not None else None)
+        if (ck_graph is not None and want_graph is not None
+                and ck_graph != want_graph and not allow_arch_mismatch):
+            raise ValueError(
+                f"graph-mode mismatch: checkpoint step {step} records "
+                f"gnn_nodes={ck_graph!r} but the scorer assembles "
+                f"{want_graph!r} neighbor tensors; restore with a "
+                f"matching graph_mode or pass allow_arch_mismatch "
+                f"(stampless legacy checkpoints restore leniently)")
         template = self.scoring_models_template(
             step=step, bert_config=scorer.bert_config,
             feature_dim=scorer.sc.feature_dim, node_dim=scorer.sc.node_dim)
@@ -412,6 +455,13 @@ def snapshot_scorer_host_state(scorer) -> Dict[str, Any]:
         "txn_cache": scorer.txn_cache,
         "users_index": scorer._users,
         "merchants_index": scorer._merchants,
+        # typed entity graph (graph/ plane): only when scorer-LOCAL — a
+        # partition-bundle-backed graph (stores= injection) snapshots
+        # with its PartitionState, never here (the handoff path owns it)
+        "typed_graph": (scorer.typed_graph
+                        if getattr(scorer, "typed_graph", None) is not None
+                        and not hasattr(scorer.typed_graph, "_store")
+                        else None),
         "stats": dict(scorer.stats),
         # the top-10 explanation importances are scorer host state too —
         # every save/restore path round-trips them, not just the train CLI's
@@ -428,6 +478,18 @@ def restore_scorer_host_state(scorer, state: Mapping[str, Any]) -> None:
     scorer.txn_cache = state["txn_cache"]
     scorer._users = state["users_index"]
     scorer._merchants = state["merchants_index"]
+    typed = state.get("typed_graph")
+    if (typed is not None
+            and getattr(scorer, "typed_graph", None) is not None
+            and not hasattr(scorer.typed_graph, "_store")):
+        # restore only into a scorer-local typed graph (a partition-
+        # bundle facade restores through handoff, not here); the sampler
+        # keeps reading the scorer's store by reference, so swap the
+        # reference it holds and drop every cached neighborhood
+        scorer.typed_graph = typed
+        scorer._sampler.graph = typed
+        scorer._sampler._cache.clear()
+        scorer._sampler._deps.clear()
     scorer.stats.update(state["stats"])
     if state.get("top_importances") is not None:
         scorer._top_importances = dict(state["top_importances"])

@@ -36,6 +36,11 @@ from typing import Any, Dict, Iterator, List, Mapping, Optional, Sequence
 import numpy as np
 
 from realtime_fraud_detection_tpu.cluster.hashring import partition_for_key
+from realtime_fraud_detection_tpu.graph.store import (
+    EDGE_TYPES,
+    TypedEntityGraph,
+    merge_neighbor_lists,
+)
 from realtime_fraud_detection_tpu.state.history import UserHistoryStore
 from realtime_fraud_detection_tpu.state.labeled import LabeledExampleBuffer
 from realtime_fraud_detection_tpu.state.stores import (
@@ -58,17 +63,34 @@ class PartitionState:
 
     def __init__(self, seq_len: int = 10, feature_dim: int = 64,
                  labeled_capacity: int = 1024,
-                 cache_kwargs: Optional[Mapping[str, Any]] = None):
+                 cache_kwargs: Optional[Mapping[str, Any]] = None,
+                 graph_fanout: int = 16):
         self.seq_len = int(seq_len)
         self.feature_dim = int(feature_dim)
         self.labeled_capacity = int(labeled_capacity)
         self.cache_kwargs = dict(cache_kwargs or {})
+        self.graph_fanout = int(graph_fanout)
         self.profiles = ProfileStore()
         self.velocity = VelocityStore()
         self.txn_cache = TransactionCache(**self.cache_kwargs)
         self.history = UserHistoryStore(self.seq_len, self.feature_dim)
         self.labeled = LabeledExampleBuffer(
             capacity=max(self.labeled_capacity, 10))
+        # typed entity graph (graph/store.py): edge data partitioned by
+        # the TRANSACTION's user key, so graph writes are always local to
+        # the owning worker and the bundle rides handoff snapshot /
+        # SIGKILL replay / the drill digests exactly like the other stores
+        self.graph = TypedEntityGraph(self.graph_fanout)
+
+    def __setstate__(self, state: Dict[str, Any]) -> None:
+        """Checkpoint migration: pre-graph-plane snapshots (PR ≤ 13 handoff
+        blobs) carry no graph bundle — restore with an empty one; the
+        committed-gap replay repopulates recent edges through the normal
+        ingest path."""
+        self.__dict__.update(state)
+        if "graph" not in state:
+            self.graph_fanout = int(state.get("graph_fanout", 16))
+            self.graph = TypedEntityGraph(self.graph_fanout)
 
     # ------------------------------------------------------------- handoff
     def snapshot_bytes(self) -> bytes:
@@ -116,6 +138,7 @@ class PartitionState:
                 np.round(hist, 5).astype(np.float32)).tobytes())
             h.update(np.ascontiguousarray(lens.astype(np.int64)).tobytes())
         feed({"labeled": self.labeled.stats()})
+        feed({"graph": self.graph.digest()})
         return h.hexdigest()
 
 
@@ -317,6 +340,114 @@ class _HistoryFacade:
         return sum(len(s.history) for s in self._store.states().values())
 
 
+class _GraphFacade:
+    """TypedEntityGraph interface over the owned-partition map.
+
+    Writes route by the transaction's USER key — the same affinity rule
+    as every other store, so graph mutation is always partition-local
+    and the bundle hands off with its partition. Reads for user-keyed
+    edge types (``user->*``) route the same way; entity-keyed reads
+    (``device->user`` etc.) merge the OWNED partitions' rings (a device
+    shared by users of several owned partitions has its adjacency spread
+    across them); non-owned shares are the fetch plane's job
+    (graph/fetch.py), not this facade's."""
+
+    def __init__(self, store: "PartitionedStore"):
+        self._store = store
+
+    @property
+    def fanout(self) -> int:
+        return self._store.graph_fanout
+
+    @property
+    def generation(self) -> int:
+        # observability stamp (stats()/graph_snapshot): any partition's
+        # ingest changes the sum. Coherence is drain_dirty +
+        # ownership_epoch, not this counter.
+        return sum(s.graph.generation
+                   for s in self._store.states().values())
+
+    @property
+    def ownership_epoch(self) -> int:
+        # wholesale-invalidation signal: acquire/release swap whole
+        # graphs without per-id dirt (NeighborSampler.sync clears on it)
+        return self._store.ownership_epoch
+
+    def add_batch(self, user_ids: Sequence[str],
+                  merchant_ids: Sequence[str],
+                  device_ids: Sequence[str], ips: Sequence[str]) -> None:
+        groups: Dict[int, List[int]] = {}
+        for i, uid in enumerate(user_ids):
+            groups.setdefault(self._store.partition_for(str(uid)),
+                              []).append(i)
+        for p, idxs in groups.items():
+            self._store.state(p).graph.add_batch(
+                [user_ids[i] for i in idxs],
+                [merchant_ids[i] for i in idxs],
+                [device_ids[i] for i in idxs],
+                [ips[i] for i in idxs])
+
+    def neighbors(self, edge_type: str, ids: Sequence[str],
+                  fanout: Optional[int] = None) -> List[List[str]]:
+        if edge_type not in EDGE_TYPES:
+            raise ValueError(f"unknown edge type {edge_type!r}")
+        k = self.fanout if fanout is None else max(1, int(fanout))
+        if edge_type.startswith("user->"):
+            out: List[List[str]] = [[] for _ in ids]
+            groups: Dict[int, List[int]] = {}
+            for i, uid in enumerate(ids):
+                groups.setdefault(self._store.partition_for(str(uid)),
+                                  []).append(i)
+            for p, idxs in groups.items():
+                state = self._store.states().get(p)
+                if state is None:
+                    continue      # non-owned user: cold locally, not a bug
+                rings = state.graph.neighbors(
+                    edge_type, [ids[i] for i in idxs], k)
+                for i, ring in zip(idxs, rings):
+                    out[i] = ring
+            return out
+        # entity-keyed: merge the owned partitions' rings in sorted
+        # partition order (deterministic; cross-partition shares arrive
+        # via the fetch plane)
+        maps = [self._store.state(p).graph.neighbor_map(edge_type, ids, k)
+                for p in self._store.owned()]
+        if not maps:
+            return [[] for _ in ids]
+        merged = merge_neighbor_lists(maps[0], maps[1:], ids, k)
+        return [merged[str(i)] for i in ids]
+
+    def neighbor_map(self, edge_type: str, ids: Sequence[str],
+                     fanout: Optional[int] = None) -> Dict[str, List[str]]:
+        """Local merged view ({id: neighbors}, empties omitted) — the
+        GraphFetchServer's read seam: exactly what THIS worker's owned
+        partitions know, never a recursive remote fetch."""
+        out: Dict[str, List[str]] = {}
+        for i, ring in zip(ids, self.neighbors(edge_type, ids, fanout)):
+            if ring:
+                out[str(i)] = ring
+        return out
+
+    def degree(self, edge_type: str, ids: Sequence[str]) -> List[int]:
+        return [len(r) for r in self.neighbors(edge_type, ids)]
+
+    def drain_dirty(self) -> List[str]:
+        dirty: set = set()
+        for s in self._store.states().values():
+            dirty.update(s.graph.drain_dirty())
+        return sorted(dirty)
+
+    def stats(self) -> Dict[str, Any]:
+        per = [s.graph.stats() for s in self._store.states().values()]
+        nodes = {t: sum(p["nodes"][t] for p in per) for t in
+                 ("user", "device", "merchant", "ip")} if per else {}
+        edges = {et: sum(p["edges"][et] for p in per)
+                 for et in EDGE_TYPES} if per else {}
+        return {"fanout": self.fanout, "generation": self.generation,
+                "edges_added": sum(p["edges_added"] for p in per),
+                "nodes": nodes, "edges": edges}
+
+
 # ----------------------------------------------------------------- store
 
 
@@ -331,7 +462,8 @@ class PartitionedStore:
 
     def __init__(self, n_partitions: int, seq_len: int = 10,
                  feature_dim: int = 64, labeled_capacity: int = 1024,
-                 cache_kwargs: Optional[Mapping[str, Any]] = None):
+                 cache_kwargs: Optional[Mapping[str, Any]] = None,
+                 graph_fanout: int = 16):
         if n_partitions < 1:
             raise ValueError(
                 f"n_partitions must be >= 1, got {n_partitions}")
@@ -340,7 +472,12 @@ class PartitionedStore:
         self.feature_dim = int(feature_dim)
         self.labeled_capacity = int(labeled_capacity)
         self.cache_kwargs = dict(cache_kwargs or {})
+        self.graph_fanout = int(graph_fanout)
         self._states: Dict[int, PartitionState] = {}
+        # bumped on every acquire/release: a handoff swaps WHOLE graphs
+        # in/out without marking per-id dirt, so ownership changes are the
+        # sampler cache's wholesale-invalidation signal
+        self.ownership_epoch = 0
         # read-mostly reference data replicated to every worker (never in
         # a handoff blob): merchant profiles
         self.shared_merchants: Dict[str, Mapping[str, Any]] = {}
@@ -349,6 +486,7 @@ class PartitionedStore:
         self.velocity = _VelocityFacade(self)
         self.txn_cache = _TxnCacheFacade(self)
         self.history = _HistoryFacade(self)
+        self.graph = _GraphFacade(self)
 
     # ------------------------------------------------------------- routing
     def partition_for(self, key: str) -> int:
@@ -377,7 +515,8 @@ class PartitionedStore:
     # ------------------------------------------------------------ ownership
     def fresh_state(self) -> PartitionState:
         return PartitionState(self.seq_len, self.feature_dim,
-                              self.labeled_capacity, self.cache_kwargs)
+                              self.labeled_capacity, self.cache_kwargs,
+                              graph_fanout=self.graph_fanout)
 
     def acquire(self, partition: int,
                 state: Optional[PartitionState] = None) -> PartitionState:
@@ -390,11 +529,14 @@ class PartitionedStore:
             raise ValueError(f"partition {partition} already owned")
         st = state if state is not None else self.fresh_state()
         self._states[partition] = st
+        self.ownership_epoch += 1
         return st
 
     def release(self, partition: int) -> PartitionState:
         """Give up a partition, returning its (live) state for snapshot."""
-        return self._states.pop(partition)
+        st = self._states.pop(partition)
+        self.ownership_epoch += 1
+        return st
 
     # -------------------------------------------------------------- summary
     def stats(self) -> Dict[str, Any]:

@@ -1,0 +1,55 @@
+"""Real-time entity-graph plane: the GNN branch's serve-time substrate.
+
+The chaos drill proved a coordinated :class:`~realtime_fraud_detection_tpu.
+sim.fraud_patterns.FraudRing` is near-invisible per-feature (incumbent
+ledger AUC 0.9255 → 0.6578 in the ring phase) — the learnable signal IS
+the shared-entity linkage (many users funneling through a handful of
+devices/IPs/merchants), which is exactly what the GraphSAGE branch
+(arXiv:1706.02216) exists for. This package gives that branch a real
+substrate:
+
+- :mod:`graph.store` — :class:`TypedEntityGraph`: a heterogeneous
+  user↔device↔merchant↔IP adjacency store with per-edge-type bounded
+  recency rings, maintained incrementally from the transaction flow at
+  finalize time and living inside ``cluster/partition.py``'s
+  ``PartitionState`` bundle (snapshot/restore/digest — handoff, SIGKILL
+  replay and the shard/elastic/partition drills carry it for free);
+- :mod:`graph.sampler` — :class:`NeighborSampler`: a deterministic
+  fixed-fanout two-hop sampler that walks ACROSS edge types
+  (user→device→user, user→IP→user, merchant→user→merchant) and emits the
+  padded ``[B,K]`` / ``[B,K,K]`` feature+mask tensors ``models/gnn.py``
+  already consumes — host-prepared gathers only, generation-stamped
+  cache (the serve-time feature-fetch problem of arXiv:2501.10546);
+- :mod:`graph.fetch` — :class:`GraphFetchClient`/:class:`GraphFetchServer`:
+  cross-partition neighbor resolution over the netbroker framing (rings
+  deliberately straddle shards), with per-batch budgets, absolute
+  deadlines and an explicit degrade-to-local-subgraph path — a
+  partitioned link yields fewer neighbors, never a wedged worker;
+- :mod:`graph.drill` — ``rtfd graph-drill``: the eleventh lockwatch
+  drill, pinning ring-phase AUC lift of graph-on vs the trees-only
+  incumbent end-to-end across ≥2 partition workers.
+"""
+
+from realtime_fraud_detection_tpu.graph.store import (  # noqa: F401
+    EDGE_TYPES,
+    NODE_TYPES,
+    TypedEntityGraph,
+)
+from realtime_fraud_detection_tpu.graph.sampler import (  # noqa: F401
+    NeighborSampler,
+)
+from realtime_fraud_detection_tpu.graph.fetch import (  # noqa: F401
+    GraphFetchClient,
+    GraphFetchServer,
+    StaleGraphGenerationError,
+)
+
+__all__ = [
+    "EDGE_TYPES",
+    "NODE_TYPES",
+    "TypedEntityGraph",
+    "NeighborSampler",
+    "GraphFetchClient",
+    "GraphFetchServer",
+    "StaleGraphGenerationError",
+]

@@ -1,0 +1,390 @@
+"""Cross-partition neighbor fetch: resolve non-owned graph nodes over TCP.
+
+Graph edge data is partitioned by the TRANSACTION's user key (writes are
+always local to the owning worker — the property that lets the graph
+bundle ride handoff snapshots), so one shared entity's adjacency — a ring
+device fingerprint serving users across several partitions — is SPREAD
+over the fleet. Rings deliberately straddle shards; a partition-scoped
+worker sampling a two-hop neighborhood must therefore resolve the remote
+shares of its frontier nodes, and that resolution sits INSIDE the
+score path's assemble stage, where the latency budget lives.
+
+The protocol follows ``cluster/handoff.py``'s framing discipline
+(netbroker length-prefixed JSON frames over one TCP connection per peer)
+with the score path's own rules layered on top:
+
+- **absolute per-batch deadline** — one wall-clock budget covers ALL
+  remote resolution for a microbatch; a slow or partitioned peer eats
+  the residual, never more (``_recv_frame(deadline=...)``, the PR 13
+  whole-frame read bound);
+- **bounded per-batch node budget** — remote lookups are capped per
+  microbatch, so a pathological frontier cannot turn one assemble into
+  a fan-out storm;
+- **degrade-to-local, never stall** — any failure (deadline, budget,
+  refused connection, netfault window, fenced generation) yields a
+  PARTIAL result and a ``degraded`` flag: the sampler falls back to the
+  local subgraph and the batch scores with fewer neighbors. A
+  partitioned link means a sparser neighborhood, not a wedged worker.
+- **backoff-gated reconnects** — a dead peer is retried on a
+  ``DeterministicBackoff`` schedule measured on the injected clock (no
+  sleeping in the score path: attempts before the next-allowed instant
+  are skipped as degraded);
+- **generation fencing awareness** — every request carries the client's
+  assignment generation; a coordinator can fence a server at a new
+  generation on rebalance, and a stale client's requests are refused
+  with a typed :class:`StaleGraphGenerationError` marker (counted,
+  degraded — the worker's own rebalance adoption refreshes the stamp;
+  the handoff-plane idiom, not a crash).
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import threading
+import time
+from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence, Tuple
+
+from realtime_fraud_detection_tpu.stream.netbroker import (
+    _recv_frame,
+    _send_frame,
+)
+
+__all__ = ["GraphFetchServer", "GraphFetchClient",
+           "StaleGraphGenerationError"]
+
+
+class StaleGraphGenerationError(RuntimeError):
+    """A fetch carried an assignment generation older than the server's
+    fence — the requester's view of partition ownership is stale (a
+    rebalance it has not adopted yet). Refused loudly server-side;
+    client-side it is a counted degrade, never a crash."""
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self) -> None:  # one connection, many requests
+        server: GraphFetchServer = self.server.outer  # type: ignore[attr-defined]
+        sock = self.request
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        server._conns.add(sock)
+        try:
+            while True:
+                try:
+                    req = _recv_frame(sock)
+                except (ConnectionError, ValueError, OSError):
+                    return
+                if req is None:
+                    return
+                try:
+                    resp = server.dispatch(req)
+                except Exception as e:  # noqa: BLE001 - per-request isolation
+                    resp = {"error": f"{type(e).__name__}: {e}"}
+                try:
+                    _send_frame(sock, resp)
+                except (ConnectionError, OSError):
+                    return
+        finally:
+            server._conns.discard(sock)
+
+
+class _TCPServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class GraphFetchServer:
+    """Serve one worker's LOCAL typed-graph view to its peers.
+
+    ``graph_source`` is a zero-arg callable returning the object to read
+    (a ``TypedEntityGraph`` or a ``PartitionedStore.graph`` facade — any
+    ``neighbor_map(edge_type, ids, fanout)`` provider); a callable so a
+    handoff that swaps the worker's store swaps the served view with it.
+    The server never fetches recursively: it answers with exactly what
+    this worker's owned partitions know.
+    """
+
+    def __init__(self, graph_source: Callable[[], Any],
+                 worker_id: str = "", host: str = "127.0.0.1",
+                 port: int = 0, max_ids_per_request: int = 512):
+        self._graph_source = graph_source
+        self.worker_id = str(worker_id)
+        self.max_ids_per_request = int(max_ids_per_request)
+        self._fence_generation = 0
+        self._lock = threading.Lock()
+        self._conns: set = set()
+        self.requests_total = 0
+        self.fenced_requests_total = 0
+        self._tcp = _TCPServer((host, port), _Handler)
+        self._tcp.outer = self  # type: ignore[attr-defined]
+        self._thread = threading.Thread(
+            target=self._tcp.serve_forever,
+            name=f"graph-fetch-{self.worker_id or 'server'}", daemon=True)
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> "GraphFetchServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._tcp.shutdown()
+        self._tcp.server_close()
+        for sock in list(self._conns):
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    @property
+    def port(self) -> int:
+        return self._tcp.server_address[1]
+
+    # ------------------------------------------------------------- fencing
+    def fence(self, generation: int) -> None:
+        """Coordinator seam: refuse requests stamped below ``generation``
+        from here on (monotonic, like the handoff fence)."""
+        with self._lock:
+            self._fence_generation = max(self._fence_generation,
+                                         int(generation))
+
+    # ------------------------------------------------------------- dispatch
+    def dispatch(self, req: Mapping[str, Any]) -> Dict[str, Any]:
+        op = req.get("op")
+        if op == "neighbors":
+            with self._lock:
+                self.requests_total += 1
+                fence = self._fence_generation
+            gen = int(req.get("generation", 0))
+            if gen < fence:
+                with self._lock:
+                    self.fenced_requests_total += 1
+                raise StaleGraphGenerationError(
+                    f"graph fetch fenced at generation {fence}; stale "
+                    f"requester at generation {gen} refused")
+            ids = [str(i) for i in (req.get("ids") or ())]
+            ids = ids[: self.max_ids_per_request]
+            graph = self._graph_source()
+            k = req.get("k")
+            return {
+                "worker": self.worker_id,
+                "neighbors": graph.neighbor_map(
+                    str(req.get("edge")), ids,
+                    int(k) if k is not None else None),
+            }
+        if op == "ping":
+            return {"pong": True, "worker": self.worker_id}
+        if op == "stats":
+            with self._lock:
+                return {"requests_total": self.requests_total,
+                        "fenced_requests_total": self.fenced_requests_total,
+                        "fence_generation": self._fence_generation}
+        raise ValueError(f"unknown op {op!r}")
+
+
+class GraphFetchClient:
+    """Score-path client resolving remote neighbor shares from peers.
+
+    One instance per worker, used from the worker's single assembly
+    thread (the scorer's own concurrency contract). Peers are
+    ``{peer_id: (host, port)}``; connections open lazily and reopen on a
+    :class:`~realtime_fraud_detection_tpu.utils.backoff.
+    DeterministicBackoff` schedule measured against the injected clock —
+    the score path NEVER sleeps for the network.
+    """
+
+    def __init__(self, peers: Mapping[str, Tuple[str, int]],
+                 deadline_ms: float = 25.0, node_budget: int = 64,
+                 connect_timeout_s: float = 1.0,
+                 clock: Optional[Callable[[], float]] = None,
+                 backoff=None, link=None):
+        from realtime_fraud_detection_tpu.utils.backoff import (
+            DeterministicBackoff,
+            instance_seed,
+        )
+
+        self.peers: Dict[str, Tuple[str, int]] = {
+            str(p): (str(h), int(port))
+            for p, (h, port) in sorted(peers.items())}
+        self.deadline_ms = float(deadline_ms)
+        self.node_budget = int(node_budget)
+        self.connect_timeout_s = float(connect_timeout_s)
+        self._clock = clock if clock is not None else time.monotonic
+        self.backoff = backoff if backoff is not None else \
+            DeterministicBackoff(base_s=0.05, mult=2.0, max_s=2.0,
+                                 seed=instance_seed("graph-fetch"),
+                                 sleep=lambda _s: None)
+        # optional in-path chaos link (chaos/netfaults.py) — None in
+        # production; the graph drill partitions this seam exactly like
+        # the broker/handoff links
+        self._link = link
+        self.generation = 0
+        self._socks: Dict[str, socket.socket] = {}
+        # peer -> (consecutive failures, next retry instant on the clock)
+        self._down: Dict[str, Tuple[int, float]] = {}
+        # per-batch state (begin_batch resets)
+        self._batch_deadline = float("inf")
+        self._budget_left = self.node_budget
+        self._batch_degraded = False
+        self._batch_deadline_hit = False
+        # cumulative counters (sync_graph mirrors as deltas)
+        self.remote_fetch_total = 0        # peer requests attempted
+        self.fetched_nodes_total = 0       # node adjacency entries received
+        self.fetch_deadline_total = 0      # batches that hit the deadline
+        self.fetch_error_total = 0         # refused/failed peer calls
+        self.budget_exhausted_total = 0    # batches that hit the node budget
+        self.stale_generation_total = 0    # fenced-generation refusals
+        self.degraded_batches_total = 0    # batches with ANY degrade cause
+
+    # ------------------------------------------------------------ lifecycle
+    def close(self) -> None:
+        for sock in self._socks.values():
+            try:
+                sock.close()
+            except OSError:
+                pass
+        self._socks.clear()
+
+    def set_generation(self, generation: int) -> None:
+        """Adopt the fleet assignment generation (stamped on requests)."""
+        self.generation = int(generation)
+
+    # ------------------------------------------------------------ batch API
+    def begin_batch(self) -> None:
+        """Open one microbatch's remote-resolution window: a fresh node
+        budget and ONE absolute deadline shared by every fetch in the
+        batch."""
+        self._batch_deadline = self._clock() + self.deadline_ms / 1e3
+        self._budget_left = self.node_budget
+        self._batch_degraded = False
+        self._batch_deadline_hit = False
+
+    def end_batch(self) -> bool:
+        """Close the window; True (and counted) when any fetch degraded.
+        The deadline counter increments here, once per MICROBATCH — the
+        sampler issues several fetch() calls per window, and each would
+        observe the same expired deadline."""
+        if self._batch_deadline_hit:
+            self.fetch_deadline_total += 1
+        if self._batch_degraded:
+            self.degraded_batches_total += 1
+        return self._batch_degraded
+
+    # -------------------------------------------------------------- fetch
+    def fetch(self, edge_type: str, ids: Sequence[str],
+              fanout: Optional[int] = None,
+              ) -> Tuple[List[Dict[str, List[str]]], bool]:
+        """Resolve ``ids``' remote adjacency shares from every reachable
+        peer. Returns (per-peer neighbor maps in sorted-peer order,
+        degraded) — partial on ANY failure; the caller merges with its
+        local view (graph.store.merge_neighbor_lists) and proceeds."""
+        ids = [str(i) for i in ids]
+        degraded = False
+        if not ids or not self.peers:
+            return [], False
+        if self._budget_left <= 0:
+            self.budget_exhausted_total += 1
+            self._batch_degraded = True
+            return [], True
+        if len(ids) > self._budget_left:
+            ids = ids[: self._budget_left]
+            self.budget_exhausted_total += 1
+            degraded = True
+        self._budget_left -= len(ids)
+        out: List[Dict[str, List[str]]] = []
+        req = {"op": "neighbors", "edge": str(edge_type), "ids": ids,
+               "generation": int(self.generation)}
+        if fanout is not None:
+            req["k"] = int(fanout)
+        for peer in self.peers:
+            now = self._clock()
+            if now >= self._batch_deadline:
+                self._batch_deadline_hit = True
+                degraded = True
+                break
+            resp = self._call_peer(peer, req)
+            if resp is None:
+                degraded = True
+                continue
+            neigh = resp.get("neighbors") or {}
+            out.append({str(i): [str(n) for n in ring]
+                        for i, ring in neigh.items()})
+            self.fetched_nodes_total += len(neigh)
+        if degraded:
+            self._batch_degraded = True
+        return out, degraded
+
+    # ---------------------------------------------------------- peer calls
+    def _call_peer(self, peer: str,
+                   req: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+        """One request/response against one peer inside the batch
+        deadline. Any failure marks the peer down (backoff-gated retry on
+        a LATER batch) and returns None — the caller degrades."""
+        down = self._down.get(peer)
+        now = self._clock()
+        if down is not None and now < down[1]:
+            self.fetch_error_total += 1
+            return None
+        sock = self._socks.get(peer)
+        try:
+            if sock is None:
+                budget = min(self.connect_timeout_s,
+                             max(self._batch_deadline - now, 1e-3))
+                sock = socket.create_connection(self.peers[peer],
+                                                timeout=budget)
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                self._socks[peer] = sock
+            if self._link is not None:
+                self._link.before_send(req, 0)
+            self.remote_fetch_total += 1
+            _send_frame(sock, req)
+            resp = _recv_frame(sock, deadline=self._batch_deadline)
+            if resp is None:
+                raise ConnectionError("graph fetch peer closed connection")
+            if self._link is not None:
+                self._link.after_recv(req)
+        except (ConnectionError, OSError, ValueError):
+            self._mark_down(peer)
+            self.fetch_error_total += 1
+            return None
+        err = resp.get("error")
+        if err is not None:
+            if str(err).startswith("StaleGraphGenerationError"):
+                # fenced: our assignment view is stale — degrade and let
+                # the worker's rebalance adoption refresh the stamp
+                self.stale_generation_total += 1
+            else:
+                self.fetch_error_total += 1
+            return None
+        self._down.pop(peer, None)
+        return resp
+
+    def _mark_down(self, peer: str) -> None:
+        sock = self._socks.pop(peer, None)
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        attempt = self._down.get(peer, (0, 0.0))[0]
+        # next allowed attempt: pure-function backoff delay on the clock,
+        # never a sleep — the score path stays non-blocking
+        self._down[peer] = (attempt + 1,
+                            self._clock() + self.backoff.delay(attempt))
+
+    # ------------------------------------------------------------- summary
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "peers": len(self.peers),
+            "peers_down": len(self._down),
+            "generation": self.generation,
+            "remote_fetch_total": self.remote_fetch_total,
+            "fetched_nodes_total": self.fetched_nodes_total,
+            "fetch_deadline_total": self.fetch_deadline_total,
+            "fetch_error_total": self.fetch_error_total,
+            "budget_exhausted_total": self.budget_exhausted_total,
+            "stale_generation_total": self.stale_generation_total,
+            "degraded_batches_total": self.degraded_batches_total,
+        }

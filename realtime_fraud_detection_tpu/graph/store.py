@@ -1,0 +1,245 @@
+"""Typed incremental entity graph: user↔device↔merchant↔IP adjacency.
+
+``state.history.EntityGraphStore`` holds only the user↔merchant bipartite
+edges, so the shared device fingerprints and egress IPs that define a
+coordinated :class:`~realtime_fraud_detection_tpu.sim.fraud_patterns.
+FraudRing` (``n_devices``/``n_ips``) never reach the GNN. This store is
+the heterogeneous replacement: four node types, six directed edge types,
+each source node keeping a bounded RECENCY RING of distinct neighbors
+(most-recent-last, oldest evicted at the fanout cap — the same dense
+fixed-fanout discipline the bipartite store uses, minus the duplicate
+entries that would let one hot counterparty flood a small ring).
+
+Identity is the STRING entity id, not a dense per-store index: adjacency
+lists must merge across partition-scoped stores (``graph.fetch``) and a
+dense index is only meaningful inside one store. The sampler resolves
+ids → feature rows at gather time (``models.gnn.typed_entity_features``
+for device/IP nodes, the scorer's entity tables for users/merchants).
+
+Concurrency: mutation and reads take one internal lock — a worker's
+:class:`~realtime_fraud_detection_tpu.graph.fetch.GraphFetchServer`
+thread reads the live store while the worker's scoring thread ingests
+at finalize time. The lock is never held across any blocking call.
+
+Determinism: pure function of the ingest order (no clocks, no RNG) —
+``cluster`` drills replay digest-identically with the graph riding
+``PartitionState``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+from typing import Any, Dict, Iterable, List, Mapping, Optional, Sequence
+
+__all__ = ["NODE_TYPES", "EDGE_TYPES", "TypedEntityGraph"]
+
+NODE_TYPES = ("user", "device", "merchant", "ip")
+
+# directed edge types; each transaction ingests the user's three
+# counterparty links in both directions
+EDGE_TYPES = (
+    "user->device", "device->user",
+    "user->merchant", "merchant->user",
+    "user->ip", "ip->user",
+)
+
+_REVERSE = {
+    "user->device": "device->user",
+    "user->merchant": "merchant->user",
+    "user->ip": "ip->user",
+}
+
+
+class TypedEntityGraph:
+    """Heterogeneous bounded-recency adjacency over string entity ids."""
+
+    def __init__(self, fanout: int = 16):
+        if fanout < 1:
+            raise ValueError(f"fanout must be >= 1, got {fanout}")
+        self.fanout = int(fanout)
+        self._adj: Dict[str, Dict[str, List[str]]] = {
+            et: {} for et in EDGE_TYPES}
+        # bumped on every mutating ingest — an observability stamp
+        # (stats()/graph_snapshot); sampler-cache COHERENCE runs on
+        # drain_dirty (exact per-id eviction) + the owner's
+        # ownership_epoch (wholesale on handoff), not on this counter
+        self.generation = 0
+        self.edges_added = 0
+        # ids whose adjacency changed since the last drain_dirty(): the
+        # sampler evicts exactly the cache entries depending on them
+        self._dirty: set = set()
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------- pickling
+    def __getstate__(self) -> Dict[str, Any]:
+        state = dict(self.__dict__)
+        del state["_lock"]           # locks don't pickle; snapshot is a copy
+        return state
+
+    def __setstate__(self, state: Dict[str, Any]) -> None:
+        self.__dict__.update(state)
+        self._lock = threading.Lock()
+
+    # -------------------------------------------------------------- ingest
+    @staticmethod
+    def _ring_add(adj: Dict[str, List[str]], src: str, dst: str,
+                  fanout: int) -> bool:
+        """Recency-ring insert: distinct neighbors, most-recent-last,
+        oldest evicted at the cap. Returns True when the ring changed."""
+        ring = adj.get(src)
+        if ring is None:
+            adj[src] = [dst]
+            return True
+        if ring and ring[-1] == dst:
+            return False                  # already the most recent
+        try:
+            ring.remove(dst)              # move-to-end on re-observation
+        except ValueError:
+            pass
+        ring.append(dst)
+        del ring[:-fanout]
+        return True
+
+    def add_transaction(self, user_id: str, merchant_id: str,
+                        device_id: str, ip: str) -> None:
+        self.add_batch([user_id], [merchant_id], [device_id], [ip])
+
+    def add_batch(self, user_ids: Sequence[str],
+                  merchant_ids: Sequence[str],
+                  device_ids: Sequence[str],
+                  ips: Sequence[str]) -> None:
+        """Ingest one finalized microbatch's entity links (both edge
+        directions per link; empty counterparty ids are skipped — a txn
+        without a device fingerprint simply contributes no device edge)."""
+        with self._lock:
+            changed = False
+            for uid, mid, did, ip in zip(user_ids, merchant_ids,
+                                         device_ids, ips):
+                uid = str(uid)
+                if not uid:
+                    continue
+                for fwd, dst in (("user->device", str(did)),
+                                 ("user->merchant", str(mid)),
+                                 ("user->ip", str(ip))):
+                    if not dst or dst == "None":
+                        continue
+                    rev = _REVERSE[fwd]
+                    if self._ring_add(self._adj[fwd], uid, dst,
+                                      self.fanout):
+                        changed = True
+                        self._dirty.add(uid)
+                    if self._ring_add(self._adj[rev], dst, uid,
+                                      self.fanout):
+                        changed = True
+                        self._dirty.add(dst)
+                    self.edges_added += 1
+            if changed:
+                self.generation += 1
+
+    # ------------------------------------------------------------- queries
+    def neighbors(self, edge_type: str, ids: Sequence[str],
+                  fanout: Optional[int] = None) -> List[List[str]]:
+        """Per-source recency lists (oldest-first, ≤ fanout each). Unknown
+        sources yield empty lists — a cold node has no neighborhood, not
+        an error."""
+        if edge_type not in EDGE_TYPES:
+            raise ValueError(f"unknown edge type {edge_type!r}; expected "
+                             f"one of {EDGE_TYPES}")
+        k = self.fanout if fanout is None else max(1, int(fanout))
+        adj = self._adj[edge_type]
+        with self._lock:
+            return [list(adj.get(str(i), ())[-k:]) for i in ids]
+
+    def neighbor_map(self, edge_type: str, ids: Iterable[str],
+                     fanout: Optional[int] = None) -> Dict[str, List[str]]:
+        """{id: neighbors} for the fetch server's wire format; sources
+        with no adjacency are omitted (the response stays proportional to
+        what this store actually knows)."""
+        ids = [str(i) for i in ids]
+        out: Dict[str, List[str]] = {}
+        for i, ring in zip(ids, self.neighbors(edge_type, ids, fanout)):
+            if ring:
+                out[i] = ring
+        return out
+
+    def degree(self, edge_type: str, ids: Sequence[str]) -> List[int]:
+        """Current ring occupancy per source (the typed node featurizer's
+        degree signal — capped at fanout by construction)."""
+        if edge_type not in EDGE_TYPES:
+            raise ValueError(f"unknown edge type {edge_type!r}")
+        adj = self._adj[edge_type]
+        with self._lock:
+            return [len(adj.get(str(i), ())) for i in ids]
+
+    # ---------------------------------------------------- sampler coherence
+    def drain_dirty(self) -> List[str]:
+        """Ids whose adjacency changed since the last drain (cleared).
+        The sampler cache evicts entries depending on exactly these."""
+        with self._lock:
+            dirty = sorted(self._dirty)
+            self._dirty.clear()
+            return dirty
+
+    # ------------------------------------------------------------- summary
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            nodes = {
+                "user": len(set(self._adj["user->device"])
+                            | set(self._adj["user->merchant"])
+                            | set(self._adj["user->ip"])),
+                "device": len(self._adj["device->user"]),
+                "merchant": len(self._adj["merchant->user"]),
+                "ip": len(self._adj["ip->user"]),
+            }
+            edges = {et: sum(len(r) for r in self._adj[et].values())
+                     for et in EDGE_TYPES}
+        return {"fanout": self.fanout, "generation": self.generation,
+                "edges_added": self.edges_added, "nodes": nodes,
+                "edges": edges}
+
+    def digest(self) -> str:
+        """Deterministic content hash over the full typed adjacency —
+        feeds ``PartitionState.digest`` so handoff snapshot/restore and
+        the drills' replay checks cover the graph bundle."""
+        with self._lock:
+            payload = {
+                et: sorted((src, tuple(ring))
+                           for src, ring in self._adj[et].items())
+                for et in EDGE_TYPES
+            }
+        h = hashlib.sha256()
+        h.update(json.dumps(payload, sort_keys=True,
+                            default=list).encode())
+        return h.hexdigest()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return sum(len(adj) for adj in self._adj.values())
+
+
+def merge_neighbor_lists(local: Mapping[str, List[str]],
+                         remotes: Sequence[Mapping[str, List[str]]],
+                         ids: Sequence[str], fanout: int,
+                         ) -> Dict[str, List[str]]:
+    """Deterministic cross-store neighborhood merge.
+
+    Edge data is partitioned by the TRANSACTION's user key (writes are
+    always partition-local), so one device's user ring is spread across
+    stores. The merged view concatenates local-first then each remote in
+    caller order (the fetch client queries peers in sorted id order),
+    dedups preserving first occurrence, and keeps the LAST ``fanout``
+    entries — recency within each source is preserved; cross-source
+    order is positional, deterministic, and documented as best-effort
+    (the graph is an enrichment signal, not handed-off truth)."""
+    out: Dict[str, List[str]] = {}
+    for i in ids:
+        i = str(i)
+        seen: Dict[str, None] = {}
+        for src in (local, *remotes):
+            for n in src.get(i, ()):
+                seen.setdefault(str(n))
+        merged = list(seen)
+        out[i] = merged[-max(1, int(fanout)):]
+    return out

@@ -28,6 +28,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+# Node-type tag slots in the node_dim feature row. Users carry no tag
+# (their stat slots 0-7 are dense); merchant=8 predates the typed graph;
+# device/ip joined with the heterogeneous entity graph (graph/store.py).
+MERCHANT_TAG_SLOT = 8
+DEVICE_TAG_SLOT = 9
+IP_TAG_SLOT = 10
+TYPED_MIN_NODE_DIM = 12     # 8 user stats + 3 type tags + 1 degree slot
+
 
 def init_gnn_params(
     key: jax.Array,
@@ -35,17 +43,35 @@ def init_gnn_params(
     txn_dim: int = 64,
     hidden: int = 64,
     head_hidden: int = 64,
+    typed: bool = False,
 ) -> Dict[str, jax.Array]:
     """GraphSAGE (2 layers) + head parameters (config.py:177-184: hidden 64,
-    3 layers total counting the head, dropout 0.1)."""
-    ks = jax.random.split(key, 6)
+    3 layers total counting the head, dropout 0.1).
+
+    ``typed=True`` adds per-node-type projection weights (the
+    heterogeneous-SAGE / R-GCN relation-weight idiom) consumed by
+    :func:`typed_node_projection` ahead of every SAGE aggregation — the
+    graph plane's device/IP node types carry degree features in a
+    different basis than user/merchant profile stats, and one shared
+    aggregation matrix would have to serve all four. The typed layout is
+    detected STRUCTURALLY by :func:`gnn_logits` (the models/quant.py
+    discipline: a scorer serves whatever parameter form it holds), and
+    the checkpoint plane arch-stamps it (``checkpoint._derive_graph_mode``)
+    so a cross-form restore is refused, never silent. The (D, D) squares
+    follow parallel/layouts.leaf_storage_spec's largest-divisible-dim
+    rule for mesh storage sharding like every other GNN leaf."""
+    # split count is mode-dependent ON PURPOSE: threefry hashes the full
+    # count into every derived key, so splitting 10 unconditionally would
+    # silently re-seed the PRE-EXISTING bipartite init (every seed-pinned
+    # untyped model would drift). typed=False keeps the committed stream.
+    ks = jax.random.split(key, 10 if typed else 6)
 
     def glorot(k, shape):
         return jax.random.normal(k, shape, jnp.float32) * float(
             np.sqrt(2.0 / (shape[0] + shape[1]))
         )
 
-    return {
+    params = {
         # layer 1: embeds the 1-hop frontier from raw node features
         "w_sage1": glorot(ks[0], (2 * node_dim, hidden)),
         "b_sage1": jnp.zeros((hidden,), jnp.float32),
@@ -57,6 +83,44 @@ def init_gnn_params(
         "w_head2": glorot(ks[3], (head_hidden, 1)),
         "b_head2": jnp.zeros((1,), jnp.float32),
     }
+    if typed:
+        if node_dim < TYPED_MIN_NODE_DIM:
+            raise ValueError(
+                f"typed GNN params need node_dim >= {TYPED_MIN_NODE_DIM} "
+                f"(type tags at slots {MERCHANT_TAG_SLOT}/"
+                f"{DEVICE_TAG_SLOT}/{IP_TAG_SLOT}), got {node_dim}")
+        eye = jnp.eye(node_dim, dtype=jnp.float32)
+        for i, name in enumerate(("user", "merchant", "device", "ip")):
+            # near-identity init: an untrained typed GNN starts close to
+            # the homogeneous one instead of scrambling the node basis
+            params[f"w_node_{name}"] = (
+                eye + 0.1 * glorot(ks[4 + i], (node_dim, node_dim)))
+    return params
+
+
+def is_typed_gnn(params: Dict[str, jax.Array]) -> bool:
+    """Structural detection of the typed parameter layout (no static flag
+    — the quant-plane discipline)."""
+    return "w_node_user" in params
+
+
+def typed_node_projection(params: Dict[str, jax.Array],
+                          feat: jax.Array) -> jax.Array:
+    """Per-node-type linear projection before aggregation.
+
+    The node type is read from the feature row's own tag slots (one-hot
+    by construction: the featurizers set exactly one of merchant/device/
+    ip, users none), so no extra type tensor rides the batch — the
+    projection blends the four relation weights by the tags, which for
+    one-hot tags selects exactly one matrix."""
+    tm = feat[..., MERCHANT_TAG_SLOT:MERCHANT_TAG_SLOT + 1]
+    td = feat[..., DEVICE_TAG_SLOT:DEVICE_TAG_SLOT + 1]
+    ti = feat[..., IP_TAG_SLOT:IP_TAG_SLOT + 1]
+    tu = jnp.clip(1.0 - tm - td - ti, 0.0, 1.0)
+    return (tu * (feat @ params["w_node_user"])
+            + tm * (feat @ params["w_node_merchant"])
+            + td * (feat @ params["w_node_device"])
+            + ti * (feat @ params["w_node_ip"]))
 
 
 def _masked_mean(x: jax.Array, mask: jax.Array) -> jax.Array:
@@ -91,6 +155,29 @@ def gnn_logits(
     def _empty_frontier(x):
         # [B, K, 1, D] zeros with an all-False mask -> masked mean yields 0
         return x[..., None, :] * 0.0, jnp.zeros(x.shape[:-1] + (1,), bool)
+
+    if is_typed_gnn(params):
+        # heterogeneous mode: the txn-feature input is clipped INSIDE the
+        # program (the LSTM branch's serving-side-clip precedent,
+        # build_sequence_dataset: raw velocity/amount features reach 1e4,
+        # far outside a trainable range) — baking the clip into the typed
+        # program means training (train_typed_gnn) and serving see
+        # identical ranges by construction, with zero train/serve skew.
+        # The bipartite program is untouched: its committed behavior
+        # (and every score pinned against it) predates the clip.
+        txn_features = jnp.clip(txn_features, -10.0, 10.0)
+        # rotate every node-feature tensor through its type's projection
+        # before any aggregation (the tags live in the rows themselves,
+        # so padded/masked rows project to near-zero and the masks still
+        # gate them out)
+        proj = lambda x: typed_node_projection(params, x)   # noqa: E731
+        user_feat, merchant_feat = proj(user_feat), proj(merchant_feat)
+        user_neigh_feat = proj(user_neigh_feat)
+        merch_neigh_feat = proj(merch_neigh_feat)
+        if user_neigh2_feat is not None:
+            user_neigh2_feat = proj(user_neigh2_feat)
+        if merch_neigh2_feat is not None:
+            merch_neigh2_feat = proj(merch_neigh2_feat)
 
     # layer 1: embed 1-hop frontier (uses 2-hop context when provided)
     if user_neigh2_feat is None:
@@ -165,3 +252,36 @@ def gather_neighbor_features(
     """Safe gather: padded (-1) indices read row 0 but are masked out."""
     safe = np.where(mask, idx, 0)
     return node_table[safe]
+
+
+def typed_entity_features(kind: str, degrees: np.ndarray, node_dim: int,
+                          fanout: int) -> np.ndarray:
+    """Node feature rows for the profile-less entity types (device / IP /
+    cold merchant) of the typed graph (graph/store.py).
+
+    These nodes have no profile store behind them; their learnable signal
+    is STRUCTURAL — how many distinct users funnel through them, which is
+    exactly the fraud-ring signature (a benign device serves one user; a
+    ring device serves the cohort). One definition shared by the serving
+    sampler AND the training dataset builder, so the GNN always sees the
+    featurization it was trained on:
+
+    - slot 0: ring occupancy / fanout  (bounded degree, in [0, 1])
+    - slot 1: log1p(degree)            (unsaturated low-end resolution)
+    - tag slot (8/9/10): 1.0 for merchant/device/ip respectively
+    """
+    tag = {"merchant": MERCHANT_TAG_SLOT, "device": DEVICE_TAG_SLOT,
+           "ip": IP_TAG_SLOT}.get(kind)
+    if tag is None:
+        raise ValueError(f"typed_entity_features kind must be "
+                         f"merchant|device|ip, got {kind!r}")
+    if node_dim < TYPED_MIN_NODE_DIM:
+        raise ValueError(
+            f"typed entity features need node_dim >= {TYPED_MIN_NODE_DIM}, "
+            f"got {node_dim}")
+    deg = np.asarray(degrees, np.float32)
+    rows = np.zeros((len(deg), node_dim), np.float32)
+    rows[:, 0] = np.minimum(deg, float(fanout)) / max(float(fanout), 1.0)
+    rows[:, 1] = np.log1p(deg)
+    rows[:, tag] = 1.0
+    return rows

@@ -705,6 +705,73 @@ class MetricsCollector:
             "generation fence (a zombie's commit must not advance the "
             "group past refused predictions)")
         self._netfault_seen: Dict[Tuple[str, str], float] = {}
+        # entity-graph plane (graph/): typed-store occupancy, sampler
+        # cache effectiveness, and the cross-partition fetch client's
+        # resolution/degrade ledger — mirrored from
+        # FraudScorer.graph_snapshot() by sync_graph at exposition time
+        # (honest counter deltas, same discipline as every sync_* mirror
+        # above)
+        self.graph_typed_mode = r.gauge(
+            "graph_typed_mode",
+            "1 while the scorer assembles typed entity-graph "
+            "neighborhoods (graph/ plane), 0 on the bipartite "
+            "user<->merchant store")
+        self.graph_nodes = r.gauge(
+            "graph_nodes",
+            "Typed-graph nodes resident by node type (partitioned "
+            "stores report the sum of owned-partition shards)",
+            ("type",))
+        self.graph_edges = r.gauge(
+            "graph_edges",
+            "Typed-graph ring entries resident by directed edge type",
+            ("edge",))
+        self.graph_edges_added = r.counter(
+            "graph_edges_added_total",
+            "Entity links ingested into the typed graph at finalize "
+            "time (both directions of one link count once)")
+        self.graph_sampler_cache_hits = r.counter(
+            "graph_sampler_cache_hits_total",
+            "Neighborhood-sampler cache hits (center sample reused)")
+        self.graph_sampler_cache_misses = r.counter(
+            "graph_sampler_cache_misses_total",
+            "Neighborhood-sampler cache misses (center sample rebuilt)")
+        self.graph_sampler_cache_evictions = r.counter(
+            "graph_sampler_cache_evictions_total",
+            "Sampler cache entries evicted (adjacency-dependency dirt, "
+            "age-out, ownership-epoch clear, or the capacity cap)")
+        self.graph_sampler_entries = r.gauge(
+            "graph_sampler_entries",
+            "Center samples currently resident in the sampler cache")
+        self.graph_remote_fetch = r.counter(
+            "graph_remote_fetch_total",
+            "Cross-partition neighbor-fetch requests sent to peer "
+            "workers")
+        self.graph_remote_nodes = r.counter(
+            "graph_remote_nodes_total",
+            "Node adjacency entries received from peer workers")
+        self.graph_fetch_deadline = r.counter(
+            "graph_fetch_deadline_total",
+            "Microbatches whose remote resolution hit the per-batch "
+            "deadline (degraded to the local subgraph)")
+        self.graph_fetch_errors = r.counter(
+            "graph_fetch_errors_total",
+            "Failed/refused peer fetch calls (connection errors, "
+            "netfault windows, backoff-gated skips)")
+        self.graph_fetch_budget_exhausted = r.counter(
+            "graph_fetch_budget_exhausted_total",
+            "Microbatches whose remote resolution hit the per-batch "
+            "node budget (partial remote view, counted as degraded)")
+        self.graph_fetch_stale_generation = r.counter(
+            "graph_fetch_stale_generation_total",
+            "Peer fetches refused at the server's assignment-generation "
+            "fence (stale requester — degraded, refreshed on rebalance "
+            "adoption)")
+        self.graph_degraded_batches = r.counter(
+            "graph_degraded_batches_total",
+            "Microbatches scored with a degraded (partial or local-only) "
+            "neighbor view for ANY reason — deadline, budget, netfault, "
+            "fenced generation")
+        self._graph_seen: Dict[str, float] = {}
 
     def sync_host_stats(self, host_stats: Mapping[str, Any]) -> None:
         """Mirror ``FraudScorer.host_stats()`` into the Prometheus series.
@@ -1013,6 +1080,60 @@ class MetricsCollector:
             if delta > 0:
                 counter.inc(delta)
             self._netfault_seen[key] = total
+
+    def sync_graph(self, snapshot: Mapping[str, Any]) -> None:
+        """Mirror a ``FraudScorer.graph_snapshot()`` into the graph_*
+        series. Called at exposition time (the sampler's score path
+        never touches the metrics lock); cumulative store/sampler/fetch
+        counts mirror as counter DELTAS against last-seen values — the
+        honest-counter scheme every sync_* mirror here uses — so a
+        stream job and a serving app syncing the same snapshot render
+        IDENTICAL series. Bipartite-mode snapshots carry only ``mode``;
+        the typed series keep their last mirrored values."""
+        self.graph_typed_mode.set(
+            1.0 if snapshot.get("mode") == "typed" else 0.0)
+        store = snapshot.get("store") or {}
+        for ntype, count in (store.get("nodes") or {}).items():
+            self.graph_nodes.set(float(count), type=str(ntype))
+        for edge, count in (store.get("edges") or {}).items():
+            self.graph_edges.set(float(count), edge=str(edge))
+
+        def delta(key: str, total: Any, counter: Counter) -> None:
+            total = float(total)
+            d = total - self._graph_seen.get(key, 0.0)
+            if d > 0:
+                counter.inc(d)
+            self._graph_seen[key] = total
+
+        if "edges_added" in store:
+            delta("edges_added", store["edges_added"],
+                  self.graph_edges_added)
+        sampler = snapshot.get("sampler") or {}
+        if sampler:
+            delta("hits", sampler.get("hits", 0),
+                  self.graph_sampler_cache_hits)
+            delta("misses", sampler.get("misses", 0),
+                  self.graph_sampler_cache_misses)
+            delta("evictions", sampler.get("evictions", 0),
+                  self.graph_sampler_cache_evictions)
+            self.graph_sampler_entries.set(
+                float(sampler.get("entries", 0)))
+        fetch = snapshot.get("fetch") or {}
+        if fetch:
+            delta("remote_fetch", fetch.get("remote_fetch_total", 0),
+                  self.graph_remote_fetch)
+            delta("remote_nodes", fetch.get("fetched_nodes_total", 0),
+                  self.graph_remote_nodes)
+            delta("deadline", fetch.get("fetch_deadline_total", 0),
+                  self.graph_fetch_deadline)
+            delta("errors", fetch.get("fetch_error_total", 0),
+                  self.graph_fetch_errors)
+            delta("budget", fetch.get("budget_exhausted_total", 0),
+                  self.graph_fetch_budget_exhausted)
+            delta("stale", fetch.get("stale_generation_total", 0),
+                  self.graph_fetch_stale_generation)
+            delta("degraded", fetch.get("degraded_batches_total", 0),
+                  self.graph_degraded_batches)
 
     def sync_cluster(self, snapshot: Mapping[str, Any]) -> None:
         """Mirror a ``cluster.fleet.WorkerFleet.snapshot()`` (stream

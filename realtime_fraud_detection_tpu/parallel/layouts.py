@@ -137,7 +137,13 @@ def leaf_storage_spec(leaf: Any, axis_size: int) -> P:
     """Generic storage spec for one serving param leaf: shard the largest
     dim divisible by the model-axis size, else replicate. The rule the
     LSTM/GNN branches use — their pytrees are flat w/b dicts with no
-    attention/FFN structure to honor."""
+    attention/FFN structure to honor. The typed-graph GNN's per-node-type
+    projection squares (``w_node_user``/``w_node_merchant``/
+    ``w_node_device``/``w_node_ip``, models/gnn.init_gnn_params
+    ``typed=True``) are (D, D) leaves in the same flat dict and take this
+    rule unchanged — D=16 divides every practical model-axis size, so the
+    new params store sharded wherever the rest of the branch does
+    (pinned in tests/test_graph.py)."""
     shape = np.shape(leaf)
     if axis_size <= 1 or not shape:
         return P()

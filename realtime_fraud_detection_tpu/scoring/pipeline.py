@@ -93,6 +93,16 @@ class ScoreBatch:
     token_ids: jax.Array             # i32[B, S] tokenized merchant/description text
     token_mask: jax.Array            # bool[B, S]
     valid: jax.Array                 # bool[B] real row (False = bucket padding)
+    # typed-graph two-hop frontier (graph/sampler.py; None in bipartite
+    # mode). None fields contribute no pytree leaves, so the legacy
+    # PackSpec — a STATIC jit argument — is byte-identical with the graph
+    # plane off: the two-hop program is a different compilation selected
+    # through the existing static-arg seam, and quant/mesh/pool compose
+    # unchanged (they shard/pack whatever leaves the batch carries).
+    user_neigh2_feat: Any = None     # f32[B, K, K2, D] users around the
+    user_neigh2_mask: Any = None     # bool[B, K, K2]   user's entities
+    merch_neigh2_feat: Any = None    # f32[B, K, K2, D] merchants around
+    merch_neigh2_mask: Any = None    # bool[B, K, K2]   the merchant's users
 
     @property
     def batch_size(self) -> int:
@@ -106,9 +116,12 @@ def init_scoring_models(
     node_dim: int = 16,
     n_trees: int = 100,
     tree_depth: int = 6,
+    gnn_typed: bool = False,
 ) -> ScoringModels:
     """Randomly-initialized model set (the reference's dummy-model fallback,
-    model_manager.py:109-121, except ours are real architectures)."""
+    model_manager.py:109-121, except ours are real architectures).
+    ``gnn_typed`` selects the heterogeneous entity-graph GNN layout
+    (per-node-type projections, graph/ plane)."""
     k_lstm, k_gnn, k_bert = jax.random.split(key, 3)
     return ScoringModels(
         trees=TreeEnsemble.zeros(n_trees, tree_depth),
@@ -119,7 +132,8 @@ def init_scoring_models(
             c_psi=jnp.asarray(8.0, jnp.float32),
         ),
         lstm=init_lstm_params(k_lstm, feature_dim=feature_dim),
-        gnn=init_gnn_params(k_gnn, node_dim=node_dim, txn_dim=feature_dim),
+        gnn=init_gnn_params(k_gnn, node_dim=node_dim, txn_dim=feature_dim,
+                            typed=gnn_typed),
         bert=init_bert_params(k_bert, bert_config),
     )
 
@@ -172,6 +186,10 @@ def _score_fused_impl(
                     batch.user_feat, batch.merchant_feat,
                     batch.user_neigh_feat, batch.user_neigh_mask,
                     batch.merch_neigh_feat, batch.merch_neigh_mask,
+                    user_neigh2_feat=batch.user_neigh2_feat,
+                    user_neigh2_mask=batch.user_neigh2_mask,
+                    merch_neigh2_feat=batch.merch_neigh2_feat,
+                    merch_neigh2_mask=batch.merch_neigh2_mask,
                 )
             ),
             iforest_predict(models.iforest, features,
@@ -284,6 +302,17 @@ class ScorerConfig:
     feature_dim: int = 64      # the §2.3 feature contract width
     node_dim: int = 16         # GNN node feature width
     fanout: int = 16           # GNN neighbor fanout (last-100-txn graph analog)
+    # GNN graph substrate: "bipartite" = the original user<->merchant
+    # EntityGraphStore neighborhoods; "typed" = the heterogeneous entity
+    # graph (graph/ plane: user<->device<->merchant<->IP, two-hop typed
+    # sampling through graph.sampler.NeighborSampler, edges ingested at
+    # finalize time, cross-partition fetch attachable). The typed tensors
+    # ride new optional ScoreBatch fields, so the mode IS the static
+    # PackSpec — no extra flag reaches the fused program.
+    graph_mode: str = "bipartite"
+    # typed mode's 2-hop width (the [B, K, K2, D] tensors; K2 < K keeps
+    # the neighbor payload bounded — bytes scale with K * K2)
+    graph_fanout2: int = 8
     text_len: int = 64         # token length for the text branch
     # "word" = hash-OOV word tokenizer (fast, no vocab file);
     # "wordpiece" = trained subword vocab with BERT's greedy longest-match

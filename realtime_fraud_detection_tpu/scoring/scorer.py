@@ -220,6 +220,20 @@ class _EntityIndex:
     def table(self) -> np.ndarray:
         return self._tbl[: self._n] if self._n else self._tbl[:1]
 
+    def peek_rows(self, entity_ids: Sequence[str]) -> np.ndarray:
+        """Feature rows for KNOWN ids, zero rows for unknown — a read-only
+        probe that never creates entries (the typed sampler resolves 2-hop
+        users that may belong to other partitions; creating index rows for
+        them would grow this table with entities this worker never
+        scores)."""
+        out = np.zeros((len(entity_ids), self.node_dim), np.float32)
+        get = self._idx.get
+        for k, eid in enumerate(entity_ids):
+            i = get(eid)
+            if i is not None:
+                out[k] = self._tbl[i]
+        return out
+
 
 class _StagingBuffers:
     """Preallocated, reused pad staging per bucket shape.
@@ -270,13 +284,22 @@ def _stage_bf16(padded):
     import ml_dtypes
 
     bf = ml_dtypes.bfloat16
-    return padded.replace(
+    out = padded.replace(
         history=np.asarray(padded.history, bf),
         user_feat=np.asarray(padded.user_feat, bf),
         merchant_feat=np.asarray(padded.merchant_feat, bf),
         user_neigh_feat=np.asarray(padded.user_neigh_feat, bf),
         merch_neigh_feat=np.asarray(padded.merch_neigh_feat, bf),
     )
+    if padded.user_neigh2_feat is not None:
+        # typed-graph two-hop context: by far the widest float payload
+        # (K x K2 x D per row) — exactly the tensors the bf16 wire format
+        # exists for
+        out = out.replace(
+            user_neigh2_feat=np.asarray(padded.user_neigh2_feat, bf),
+            merch_neigh2_feat=np.asarray(padded.merch_neigh2_feat, bf),
+        )
+    return out
 
 
 class FraudScorer:
@@ -395,6 +418,34 @@ class FraudScorer:
             self.history = UserHistoryStore(self.sc.seq_len,
                                             self.sc.feature_dim)
         self.graph = EntityGraphStore(self.sc.fanout)
+        # typed entity-graph plane (graph/): heterogeneous
+        # user<->device<->merchant<->IP neighborhoods for the GNN branch.
+        # The store rides the injected partition bundle when one is given
+        # (PartitionedStore.graph facade — snapshot/handoff/digest for
+        # free); otherwise it is scorer-local like the bipartite store.
+        if self.sc.graph_mode not in ("bipartite", "typed"):
+            raise ValueError(
+                f"ScorerConfig.graph_mode must be 'bipartite' or 'typed', "
+                f"got {self.sc.graph_mode!r}")
+        self.typed_graph = None
+        self._sampler = None
+        if self.sc.graph_mode == "typed":
+            from realtime_fraud_detection_tpu.graph.sampler import (
+                NeighborSampler,
+            )
+            from realtime_fraud_detection_tpu.graph.store import (
+                TypedEntityGraph,
+            )
+
+            tg = getattr(stores, "graph", None) if stores is not None \
+                else None
+            self.typed_graph = (tg if tg is not None
+                                else TypedEntityGraph(self.sc.fanout))
+            self._sampler = NeighborSampler(
+                self.typed_graph, self.sc.node_dim, self.sc.fanout,
+                self.sc.graph_fanout2,
+                user_rows=lambda ids: self._users.peek_rows(ids),
+                merchant_rows=lambda ids: self._merchants.peek_rows(ids))
         if self.sc.tokenizer == "wordpiece":
             from realtime_fraud_detection_tpu.models.wordpiece import (
                 WordPieceTokenizer,
@@ -473,6 +524,31 @@ class FraudScorer:
         Called by DevicePool.__init__ — construct the scorer first, then
         the pool around it."""
         self._pool = pool
+
+    # --------------------------------------------------------- graph plane
+    def attach_graph_fetch(self, client) -> None:
+        """Adopt a graph.fetch.GraphFetchClient: the typed sampler
+        resolves non-owned neighbor nodes through it (budgeted,
+        deadlined, degrade-to-local). Typed graph mode only."""
+        if self._sampler is None:
+            raise ValueError(
+                "attach_graph_fetch needs ScorerConfig.graph_mode='typed'")
+        self._sampler.attach_fetch(client)
+
+    def graph_snapshot(self) -> Dict[str, Any]:
+        """Graph-plane observability payload
+        (obs.metrics.MetricsCollector.sync_graph): typed-store node/edge
+        counts by type, sampler cache hits/misses/evictions, and — when a
+        fetch client is attached — the cross-partition resolution
+        counters. Bipartite mode reports just the mode (the legacy store
+        has no typed series to mirror)."""
+        snap: Dict[str, Any] = {"mode": self.sc.graph_mode}
+        if self.typed_graph is not None:
+            snap["store"] = self.typed_graph.stats()
+            snap["sampler"] = self._sampler.stats()
+            if self._sampler.fetch is not None:
+                snap["fetch"] = self._sampler.fetch.stats()
+        return snap
 
     @property
     def pool(self):
@@ -654,17 +730,10 @@ class FraudScorer:
         self.last_features = feats  # host copy for feature-topic fan-out
         history, history_len = self.history.append_and_gather(user_ids, feats)
 
-        # entity graph for the GNN branch
+        # entity graph for the GNN branch (ONE seam for both assemble paths)
         u_idx = self._users.lookup_batch(user_ids, uprofs, False)
         m_idx = self._merchants.lookup_batch(merchant_ids, mprofs, True)
-        un_idx, un_mask = self.graph.user_neighbors(u_idx)
-        mn_idx, mn_mask = self.graph.merchant_neighbors(m_idx)
-        utable, mtable = self._users.table(), self._merchants.table()
-        user_feat = utable[u_idx]
-        merchant_feat = mtable[m_idx]
-        un_feat = mtable[np.where(un_mask, un_idx, 0)]
-        mn_feat = utable[np.where(mn_mask, mn_idx, 0)]
-        self.graph.add_edges(u_idx, m_idx)
+        graph_t = self._graph_join(user_ids, merchant_ids, u_idx, m_idx)
 
         token_ids, token_mask = self.tokenizer.encode_batch(
             self._texts_for(records, merchant_ids, mprofs))
@@ -674,19 +743,53 @@ class FraudScorer:
             features=feats,
             history=history,
             history_len=history_len,
-            user_feat=user_feat,
-            merchant_feat=merchant_feat,
-            user_neigh_feat=un_feat,
-            user_neigh_mask=un_mask,
-            merch_neigh_feat=mn_feat,
-            merch_neigh_mask=mn_mask,
             token_ids=token_ids.astype(np.int32),
             token_mask=token_mask.astype(bool),
             valid=np.ones((len(records),), bool),
+            **graph_t,
         )
         # rtfd-lint: allow[wall-clock] span diagnostics (host_stats), not scoring control flow
         self.spans.record("assemble", time.perf_counter() - t0)
         return batch
+
+    def _graph_join(self, user_ids: Sequence[str],
+                    merchant_ids: Sequence[str],
+                    u_idx: np.ndarray, m_idx: np.ndarray,
+                    ) -> Dict[str, np.ndarray]:
+        """The GNN branch's graph tensors — the ONE seam both assemble
+        paths (columnar and record-at-a-time serial) call, so graph-on
+        can never diverge columnar-vs-serial (edge maintenance used to
+        live in two hand-kept copies).
+
+        Bipartite mode keeps the historical sample-then-insert order:
+        this batch's neighborhoods see only earlier batches' edges, then
+        the batch's own edges are committed for the NEXT batch. Typed
+        mode samples here too, but commits edges at FINALIZE time
+        (``_write_back`` → ``TypedEntityGraph.add_batch``): the typed
+        store lives in the partition bundle, and write-back is where
+        every other partition-owned store mutates."""
+        # rtfd-lint: allow[wall-clock] span diagnostics (host_stats), not scoring control flow
+        t0 = time.perf_counter()
+        utable, mtable = self._users.table(), self._merchants.table()
+        out: Dict[str, np.ndarray] = {
+            "user_feat": utable[u_idx],
+            "merchant_feat": mtable[m_idx],
+        }
+        if self._sampler is not None:
+            out.update(self._sampler.sample(user_ids, merchant_ids))
+        else:
+            un_idx, un_mask = self.graph.user_neighbors(u_idx)
+            mn_idx, mn_mask = self.graph.merchant_neighbors(m_idx)
+            out.update(
+                user_neigh_feat=mtable[np.where(un_mask, un_idx, 0)],
+                user_neigh_mask=un_mask,
+                merch_neigh_feat=utable[np.where(mn_mask, mn_idx, 0)],
+                merch_neigh_mask=mn_mask,
+            )
+            self.graph.add_edges(u_idx, m_idx)
+        # rtfd-lint: allow[wall-clock] span diagnostics (host_stats), not scoring control flow
+        self.spans.record("graph", time.perf_counter() - t0)
+        return out
 
     def _texts_for(self, records, merchant_ids, mprofs) -> List[str]:
         """Combined text per record for the text branch (models/text.py)."""
@@ -756,12 +859,7 @@ class FraudScorer:
             tok_rows.append(ids)
             tok_masks.append(mask)
 
-        un_idx, un_mask = self.graph.user_neighbors(u_idx)
-        mn_idx, mn_mask = self.graph.merchant_neighbors(m_idx)
-        utable, mtable = self._users.table(), self._merchants.table()
-        un_feat = mtable[np.where(un_mask, un_idx, 0)]
-        mn_feat = utable[np.where(mn_mask, mn_idx, 0)]
-        self.graph.add_edges(u_idx, m_idx)
+        graph_t = self._graph_join(user_ids, merchant_ids, u_idx, m_idx)
 
         txn_all = jax.tree_util.tree_map(
             lambda *leaves: np.concatenate([np.asarray(lf) for lf in leaves],
@@ -773,15 +871,10 @@ class FraudScorer:
             features=feats,
             history=np.concatenate(hist_rows, axis=0),
             history_len=np.concatenate(hist_lens, axis=0),
-            user_feat=utable[u_idx],
-            merchant_feat=mtable[m_idx],
-            user_neigh_feat=un_feat,
-            user_neigh_mask=un_mask,
-            merch_neigh_feat=mn_feat,
-            merch_neigh_mask=mn_mask,
             token_ids=np.concatenate(tok_rows, axis=0).astype(np.int32),
             token_mask=np.concatenate(tok_masks, axis=0).astype(bool),
             valid=np.ones((n,), bool),
+            **graph_t,
         )
 
     def host_stats(self) -> Dict[str, Any]:
@@ -1101,6 +1194,20 @@ class FraudScorer:
             merged["risk_level"] = res["risk_level"]
             merged["confidence"] = res["confidence"]
             self.txn_cache.cache_transaction(merged, now=ts)
+        if self.typed_graph is not None:
+            # typed-graph ingest at the finalize seam: the shared
+            # device_id/ip_address entity links (the FraudRing signature)
+            # flow into per-entity state through ONE path-independent
+            # seam — replay_state takes it too, so handoff's committed-
+            # gap replay rebuilds the graph exactly like the live pass
+            self.typed_graph.add_batch(
+                [str(r.get("user_id", "")) for r in records],
+                [str(r.get("merchant_id", "")) for r in records],
+                [str(r.get("device_id")
+                     or r.get("device_fingerprint") or "")
+                 for r in records],
+                [str(r.get("ip_address") or "") for r in records])
+            self._sampler.sync()
 
     def close(self) -> None:
         """Release resources this scorer owns (currently: the state-tier

@@ -1,0 +1,764 @@
+"""Entity-graph plane tests (ISSUE 14): typed store units, sampler
+determinism + cache coherence, fetch deadline/budget/degrade/fencing
+paths, sync_graph mirror pins, the PartitionState handoff regression pin
+(the graph bundle rides snapshot/restore digest-equal), columnar==serial
+with graph sampling enabled, typed-GNN storage specs + checkpoint
+graph-mode stamp, and the `rtfd graph-drill --fast` tier-1 smoke."""
+
+import json
+import pickle
+
+import numpy as np
+import pytest
+
+from realtime_fraud_detection_tpu.graph import (
+    GraphFetchClient,
+    GraphFetchServer,
+    NeighborSampler,
+    TypedEntityGraph,
+)
+from realtime_fraud_detection_tpu.graph.store import merge_neighbor_lists
+
+
+def _zeros_rows(node_dim):
+    return lambda ids: np.zeros((len(ids), node_dim), np.float32)
+
+
+# ---------------------------------------------------------------------------
+# typed store
+# ---------------------------------------------------------------------------
+
+
+class TestTypedEntityGraph:
+    def test_recency_ring_bounded_and_distinct(self):
+        g = TypedEntityGraph(fanout=3)
+        for i in range(6):
+            g.add_transaction("u1", f"m{i}", "d1", "ip1")
+        rings = g.neighbors("user->merchant", ["u1"])
+        assert rings == [["m3", "m4", "m5"]]          # oldest evicted
+        # re-observation moves to end, never duplicates
+        g.add_transaction("u1", "m4", "d1", "ip1")
+        assert g.neighbors("user->merchant", ["u1"]) == [["m3", "m5", "m4"]]
+
+    def test_both_directions_and_empty_ids_skipped(self):
+        g = TypedEntityGraph(fanout=4)
+        g.add_batch(["u1", "u2"], ["m1", "m1"], ["d1", ""], ["", "ip1"])
+        assert g.neighbors("merchant->user", ["m1"]) == [["u1", "u2"]]
+        assert g.neighbors("device->user", ["d1"]) == [["u1"]]
+        # u1 had no ip, u2 no device
+        assert g.neighbors("user->ip", ["u1"]) == [[]]
+        assert g.neighbors("user->device", ["u2"]) == [[]]
+
+    def test_unknown_edge_type_raises(self):
+        g = TypedEntityGraph()
+        with pytest.raises(ValueError, match="unknown edge type"):
+            g.neighbors("user->user", ["u1"])
+
+    def test_digest_and_pickle_round_trip(self):
+        g = TypedEntityGraph(fanout=4)
+        g.add_batch(["u1", "u2"], ["m1", "m2"], ["d1", "d1"],
+                    ["ip1", "ip2"])
+        d = g.digest()
+        assert d == g.digest()                       # stable
+        g2 = pickle.loads(pickle.dumps(g))
+        assert g2.digest() == d
+        g2.add_transaction("u3", "m1", "d1", "ip1")
+        assert g2.digest() != d                      # content-sensitive
+
+    def test_dirty_tracking_drains_touched_ids(self):
+        g = TypedEntityGraph(fanout=4)
+        g.add_transaction("u1", "m1", "d1", "ip1")
+        assert g.drain_dirty() == ["d1", "ip1", "m1", "u1"]
+        assert g.drain_dirty() == []
+        # a no-op re-observation (already most recent) marks nothing
+        g.add_transaction("u1", "m1", "d1", "ip1")
+        assert g.drain_dirty() == []
+
+    def test_degree_and_stats(self):
+        g = TypedEntityGraph(fanout=8)
+        g.add_batch(["u1", "u2", "u3"], ["m1"] * 3, ["d1"] * 3,
+                    ["ip1", "ip2", "ip3"])
+        assert g.degree("device->user", ["d1", "dX"]) == [3, 0]
+        st = g.stats()
+        assert st["nodes"] == {"user": 3, "device": 1, "merchant": 1,
+                               "ip": 3}
+        assert st["edges"]["device->user"] == 3
+
+    def test_merge_neighbor_lists_deterministic_dedup(self):
+        local = {"d1": ["u1", "u2"]}
+        remote = [{"d1": ["u2", "u3"]}, {"d1": ["u4"]}]
+        merged = merge_neighbor_lists(local, remote, ["d1", "d9"], 3)
+        assert merged["d1"] == ["u2", "u3", "u4"]    # last-3 of dedup
+        assert merged["d9"] == []
+
+
+# ---------------------------------------------------------------------------
+# PartitionState / PartitionedStore integration (the handoff pin)
+# ---------------------------------------------------------------------------
+
+
+class TestPartitionGraphBundle:
+    def test_handoff_snapshot_restore_digest_equal(self):
+        """ISSUE 14 regression pin: the graph bundle rides handoff
+        snapshot/restore digest-equal — a restored partition's graph is
+        byte-for-byte the snapshotted one."""
+        from realtime_fraud_detection_tpu.cluster.partition import (
+            PartitionState,
+        )
+
+        ps = PartitionState(seq_len=4, feature_dim=4)
+        ps.graph.add_batch(["u1", "u2"], ["m1", "m1"], ["d1", "d1"],
+                           ["ip1", "ip2"])
+        ps.profiles.put_user("u1", {"user_id": "u1", "txn_count": 1})
+        d = ps.digest(now=0.0)
+        restored = PartitionState.restore_bytes(ps.snapshot_bytes())
+        assert restored.digest(now=0.0) == d
+        assert restored.graph.neighbors("device->user", ["d1"]) == [
+            ["u1", "u2"]]
+        # the digest SEES the graph: new edges change it
+        restored.graph.add_transaction("u3", "m1", "d1", "ip1")
+        assert restored.digest(now=0.0) != d
+
+    def test_pre_graph_blob_restores_with_empty_graph(self):
+        from realtime_fraud_detection_tpu.cluster.partition import (
+            PartitionState,
+        )
+
+        ps = PartitionState()
+        legacy = {k: v for k, v in ps.__dict__.items()
+                  if k not in ("graph", "graph_fanout")}
+        migrated = PartitionState.__new__(PartitionState)
+        migrated.__setstate__(legacy)
+        assert len(migrated.graph) == 0
+        assert migrated.graph.fanout == 16
+
+    def test_facade_routes_by_user_key_and_merges_entity_reads(self):
+        from realtime_fraud_detection_tpu.cluster.partition import (
+            PartitionedStore,
+        )
+
+        store = PartitionedStore(4, graph_fanout=8)
+        for p in range(4):
+            store.acquire(p)
+        store.graph.add_batch(["uA", "uB", "uC"], ["m1"] * 3, ["dX"] * 3,
+                              ["ip1"] * 3)
+        # every user's edges landed in ITS partition; the entity-keyed
+        # read merges the owned shards
+        assert sorted(store.graph.neighbors("device->user", ["dX"])[0]) \
+            == ["uA", "uB", "uC"]
+        per_part = [s.graph.stats()["edges"]["user->device"]
+                    for s in store.states().values()]
+        assert sum(per_part) == 3
+        # ownership epoch moves on acquire/release (sampler wholesale
+        # invalidation signal)
+        e0 = store.graph.ownership_epoch
+        store.release(0)
+        assert store.graph.ownership_epoch == e0 + 1
+
+
+# ---------------------------------------------------------------------------
+# sampler
+# ---------------------------------------------------------------------------
+
+
+def _ring_graph(fanout=8):
+    """u1 has device d1 (shared with u2, u3), ip i1, merchant m1."""
+    g = TypedEntityGraph(fanout=fanout)
+    g.add_batch(["u1", "u2", "u3"], ["m1", "m2", "m2"],
+                ["d1", "d1", "d1"], ["i1", "i2", "i3"])
+    g.drain_dirty()
+    return g
+
+
+class TestNeighborSampler:
+    def test_masks_and_center_exclusion(self):
+        g = _ring_graph()
+        s = NeighborSampler(g, 16, 4, 4, _zeros_rows(16), _zeros_rows(16))
+        out = s.sample(["u1"], ["m1"])
+        # frontier: device d1, ip i1, merchant m1 -> 3 slots
+        assert out["user_neigh_mask"][0].sum() == 3
+        assert out["user_neigh_feat"].shape == (1, 4, 16)
+        assert out["user_neigh2_feat"].shape == (1, 4, 4, 16)
+        # d1's 2-hop users exclude the center u1 -> {u2, u3}
+        from realtime_fraud_detection_tpu.models.gnn import DEVICE_TAG_SLOT
+
+        dev_slot = int(np.argmax(
+            out["user_neigh_feat"][0][:, DEVICE_TAG_SLOT]))
+        assert out["user_neigh2_mask"][0, dev_slot].sum() == 2
+        # merchant center m1: users [u1]; 2-hop = u1's merchant ring
+        # minus m1 -> empty
+        assert out["merch_neigh_mask"][0].sum() == 1
+        assert out["merch_neigh2_mask"][0].sum() == 0
+        # padded rows are zero and masked off
+        assert not out["user_neigh_mask"][0, 3]
+        assert np.all(out["user_neigh_feat"][0, 3] == 0.0)
+
+    def test_device_rows_carry_degree_and_tag(self):
+        from realtime_fraud_detection_tpu.models.gnn import (
+            DEVICE_TAG_SLOT,
+            IP_TAG_SLOT,
+        )
+
+        g = _ring_graph()
+        s = NeighborSampler(g, 16, 4, 4, _zeros_rows(16), _zeros_rows(16))
+        out = s.sample(["u1"], ["m1"])
+        feat = out["user_neigh_feat"][0]
+        dev_rows = feat[:, DEVICE_TAG_SLOT] > 0
+        ip_rows = feat[:, IP_TAG_SLOT] > 0
+        assert dev_rows.sum() == 1 and ip_rows.sum() == 1
+        # degree slot 0: d1 serves 2 non-center users + center = 3 of
+        # fanout2=4
+        assert feat[dev_rows][0, 0] == pytest.approx(3 / 4)
+
+    def test_deterministic_across_fresh_samplers(self):
+        g1, g2 = _ring_graph(), _ring_graph()
+        s1 = NeighborSampler(g1, 16, 4, 4, _zeros_rows(16),
+                             _zeros_rows(16))
+        s2 = NeighborSampler(g2, 16, 4, 4, _zeros_rows(16),
+                             _zeros_rows(16))
+        a = s1.sample(["u1", "u2"], ["m1", "m2"])
+        b = s2.sample(["u1", "u2"], ["m1", "m2"])
+        for k in a:
+            assert np.array_equal(a[k], b[k]), k
+
+    def test_cache_hits_and_dependency_eviction(self):
+        g = _ring_graph()
+        s = NeighborSampler(g, 16, 4, 4, _zeros_rows(16), _zeros_rows(16))
+        s.sample(["u1"], ["m1"])
+        misses0 = s.misses
+        s.sample(["u1"], ["m1"])                      # clean reuse
+        assert s.misses == misses0 and s.hits >= 1
+        # a new edge through d1 dirties it -> u1's entry (dep d1) evicts
+        before = s.sample(["u1"], ["m1"])
+        g.add_batch(["u9"], ["m9"], ["d1"], ["i9"])
+        s.sync()
+        after = s.sample(["u1"], ["m1"])
+        assert s.evictions >= 1
+        from realtime_fraud_detection_tpu.models.gnn import DEVICE_TAG_SLOT
+
+        slot = int(np.argmax(
+            after["user_neigh_feat"][0][:, DEVICE_TAG_SLOT]))
+        # u9 joined d1's 2-hop ring
+        assert after["user_neigh2_mask"][0, slot].sum() \
+            == before["user_neigh2_mask"][0, slot].sum() + 1
+
+    def test_ownership_epoch_clears_wholesale(self):
+        class EpochGraph(TypedEntityGraph):
+            ownership_epoch = 0
+
+        g = EpochGraph(fanout=4)
+        g.add_batch(["u1"], ["m1"], ["d1"], ["i1"])
+        s = NeighborSampler(g, 16, 4, 4, _zeros_rows(16), _zeros_rows(16))
+        s.sample(["u1"], ["m1"])
+        assert s.stats()["entries"] > 0
+        g.ownership_epoch = 1
+        s.sync()
+        assert s.stats()["entries"] == 0
+
+    def test_age_out_bounds_staleness(self):
+        g = _ring_graph()
+        s = NeighborSampler(g, 16, 4, 4, _zeros_rows(16),
+                            _zeros_rows(16), max_entry_age=2)
+        s.sample(["u1"], ["m1"])                      # 2 entries (u + m)
+        s.sync()
+        s.sample(["u1"], ["m1"])                      # 1 sync old: hits
+        assert s.misses == 2 and s.hits == 2
+        s.sync()
+        # 2 syncs old: the lazy probe treats both entries as stale and
+        # rebuilds them — bounded staleness without a per-sync full scan
+        s.sample(["u1"], ["m1"])
+        assert s.misses == 4
+        assert s.evictions >= 2
+
+    def test_capacity_cap_never_wipes_a_probed_center_mid_batch(self):
+        """Review regression pin: the wholesale capacity clear happens
+        BEFORE the probes, so a batch mixing cache hits and misses can
+        never lose a hit center's entry between probe and scatter."""
+        g = _ring_graph()
+        s = NeighborSampler(g, 16, 4, 4, _zeros_rows(16),
+                            _zeros_rows(16), max_entries=2)
+        s.sample(["u1"], ["m1"])                      # fills to the cap
+        # at the cap the clear runs BEFORE the probes; every center of
+        # this batch rebuilds and the scatter finds all of them (a
+        # mid-batch clear would KeyError on a probed hit)
+        out = s.sample(["u1", "u2"], ["m1", "m2"])
+        assert out["user_neigh_mask"].shape == (2, 4)
+        assert s.stats()["entries"] == 4
+
+
+# ---------------------------------------------------------------------------
+# fetch plane
+# ---------------------------------------------------------------------------
+
+
+class TestGraphFetch:
+    def _server(self, graph):
+        return GraphFetchServer(lambda: graph, worker_id="w0").start()
+
+    def test_round_trip_and_merge(self):
+        g = _ring_graph()
+        srv = self._server(g)
+        try:
+            c = GraphFetchClient({"w0": ("127.0.0.1", srv.port)},
+                                 deadline_ms=2_000.0, node_budget=64)
+            c.begin_batch()
+            maps, degraded = c.fetch("device->user", ["d1", "dX"], 8)
+            assert not degraded
+            assert maps[0]["d1"] == ["u1", "u2", "u3"]
+            assert "dX" not in maps[0]                # empties omitted
+            assert c.remote_fetch_total == 1
+            assert c.fetched_nodes_total == 1
+            assert not c.end_batch()
+            c.close()
+        finally:
+            srv.stop()
+
+    def test_budget_truncates_and_counts(self):
+        g = _ring_graph()
+        srv = self._server(g)
+        try:
+            c = GraphFetchClient({"w0": ("127.0.0.1", srv.port)},
+                                 deadline_ms=2_000.0, node_budget=1)
+            c.begin_batch()
+            maps, degraded = c.fetch("device->user", ["d1", "dX"], 8)
+            assert degraded and c.budget_exhausted_total == 1
+            # second fetch in the same batch: budget gone entirely
+            maps2, degraded2 = c.fetch("ip->user", ["i1"], 8)
+            assert degraded2 and maps2 == []
+            assert c.end_batch()
+            assert c.degraded_batches_total == 1
+            c.close()
+        finally:
+            srv.stop()
+
+    def test_deadline_degrades_without_stalling(self):
+        c = GraphFetchClient({"w0": ("127.0.0.1", 1)},  # never contacted
+                             deadline_ms=0.0, node_budget=64)
+        c.begin_batch()
+        maps, degraded = c.fetch("device->user", ["d1"], 8)
+        assert degraded and maps == []
+        # several expired fetches in ONE window count ONE deadline batch
+        # (graph_fetch_deadline_total must stay <= degraded_batches_total)
+        c.fetch("ip->user", ["i1"], 8)
+        assert c.end_batch()
+        assert c.fetch_deadline_total == 1
+        assert c.degraded_batches_total == 1
+        assert c.remote_fetch_total == 0
+
+    def test_dead_peer_backoff_gated_no_sleep(self):
+        tnow = [0.0]
+        c = GraphFetchClient({"w0": ("127.0.0.1", 9)},  # refused port
+                             deadline_ms=50.0, node_budget=64,
+                             clock=lambda: tnow[0])
+        c.begin_batch()
+        _, degraded = c.fetch("device->user", ["d1"], 8)
+        assert degraded and c.fetch_error_total == 1
+        # immediately after: the peer is down, the attempt is SKIPPED
+        # (backoff-gated on the injected clock — no sleep, no connect)
+        c.begin_batch()
+        c.fetch("device->user", ["d1"], 8)
+        assert c.fetch_error_total == 2
+        assert not c.backoff.slept                 # never slept
+        # past the backoff delay the client tries the connect again
+        tnow[0] += 10.0
+        c.begin_batch()
+        c.fetch("device->user", ["d1"], 8)
+        assert c.fetch_error_total == 3
+
+    def test_generation_fencing_refused_and_adopted(self):
+        g = _ring_graph()
+        srv = self._server(g)
+        try:
+            srv.fence(5)
+            c = GraphFetchClient({"w0": ("127.0.0.1", srv.port)},
+                                 deadline_ms=2_000.0, node_budget=64)
+            c.begin_batch()
+            maps, degraded = c.fetch("device->user", ["d1"], 8)
+            assert degraded and maps == []
+            assert c.stale_generation_total == 1
+            assert srv.fenced_requests_total == 1
+            c.set_generation(5)                      # rebalance adoption
+            c.begin_batch()
+            maps, degraded = c.fetch("device->user", ["d1"], 8)
+            assert not degraded and maps[0]["d1"]
+            c.close()
+        finally:
+            srv.stop()
+
+    def test_netfault_link_partition_degrades(self):
+        from realtime_fraud_detection_tpu.chaos.netfaults import LinkState
+
+        g = _ring_graph()
+        srv = self._server(g)
+        try:
+            link = LinkState("graphfetch", "peers", sleep=lambda _s: None)
+            c = GraphFetchClient({"w0": ("127.0.0.1", srv.port)},
+                                 deadline_ms=2_000.0, node_budget=64,
+                                 link=link)
+            link.set_partition("full")
+            c.begin_batch()
+            _, degraded = c.fetch("device->user", ["d1"], 8)
+            assert degraded and link.partitioned_sends == 1
+            assert c.end_batch()
+            link.clear_partition()
+        finally:
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# typed GNN: projection, storage specs, checkpoint stamp
+# ---------------------------------------------------------------------------
+
+
+class TestTypedGnn:
+    def test_typed_projection_selects_by_tag(self):
+        import jax
+
+        from realtime_fraud_detection_tpu.models.gnn import (
+            DEVICE_TAG_SLOT,
+            init_gnn_params,
+            is_typed_gnn,
+            typed_node_projection,
+        )
+
+        params = init_gnn_params(jax.random.PRNGKey(0), typed=True)
+        assert is_typed_gnn(params)
+        feat = np.zeros((2, 16), np.float32)
+        feat[0, 0] = 1.0                              # user row (no tag)
+        feat[1, 0] = 1.0
+        feat[1, DEVICE_TAG_SLOT] = 1.0                # device row
+        out = np.asarray(typed_node_projection(params, feat))
+        want_u = feat[0] @ np.asarray(params["w_node_user"])
+        want_d = feat[1] @ np.asarray(params["w_node_device"])
+        np.testing.assert_allclose(out[0], want_u, rtol=1e-6)
+        np.testing.assert_allclose(out[1], want_d, rtol=1e-6)
+
+    def test_typed_params_take_storage_sharding(self):
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        from realtime_fraud_detection_tpu.models.bert import BertConfig
+        from realtime_fraud_detection_tpu.parallel.layouts import (
+            branch_serving_specs,
+        )
+        from realtime_fraud_detection_tpu.scoring.pipeline import (
+            init_scoring_models,
+        )
+
+        models = init_scoring_models(
+            jax.random.PRNGKey(0),
+            bert_config=BertConfig(vocab_size=256, hidden_size=16,
+                                   num_layers=1, num_heads=2,
+                                   intermediate_size=32),
+            gnn_typed=True)
+        specs = branch_serving_specs(models, 2, ["graph_neural"])
+        for name in ("w_node_user", "w_node_merchant", "w_node_device",
+                     "w_node_ip"):
+            # (16, 16) squares shard over the model axis like every
+            # other GNN leaf (the leaf_storage_spec rule)
+            assert specs.gnn[name] != P(), name
+
+    def test_checkpoint_graph_mode_stamp_and_refusal(self, tmp_path):
+        import jax
+
+        from realtime_fraud_detection_tpu.checkpoint import (
+            CheckpointManager,
+            _derive_graph_mode,
+        )
+        from realtime_fraud_detection_tpu.models.bert import BertConfig
+        from realtime_fraud_detection_tpu.scoring import (
+            FraudScorer,
+            ScorerConfig,
+        )
+        from realtime_fraud_detection_tpu.scoring.pipeline import (
+            init_scoring_models,
+        )
+
+        bc = BertConfig(vocab_size=256, hidden_size=16, num_layers=1,
+                        num_heads=2, intermediate_size=32)
+        typed = init_scoring_models(jax.random.PRNGKey(0), bert_config=bc,
+                                    n_trees=4, tree_depth=3,
+                                    gnn_typed=True)
+        assert _derive_graph_mode(typed) == {"gnn_nodes": "typed"}
+        plain = init_scoring_models(jax.random.PRNGKey(0), bert_config=bc,
+                                    n_trees=4, tree_depth=3)
+        assert _derive_graph_mode(plain) == {"gnn_nodes": "bipartite"}
+
+        mgr = CheckpointManager(tmp_path / "ck")
+        mgr.save(1, params=typed)
+        assert mgr.manifest(1)["graph_mode"] == {"gnn_nodes": "typed"}
+        # a typed checkpoint must not silently restore into a scorer
+        # assembling bipartite neighbor tensors
+        scorer = FraudScorer(models=plain, bert_config=bc,
+                             scorer_config=ScorerConfig())
+        with pytest.raises(ValueError, match="graph-mode mismatch"):
+            mgr.restore_into_scorer(scorer)
+
+
+# ---------------------------------------------------------------------------
+# scorer integration: one seam, finalize-time ingest, columnar == serial
+# ---------------------------------------------------------------------------
+
+
+def _typed_scorer_pair(seed=9):
+    import jax
+
+    from realtime_fraud_detection_tpu.models.bert import BertConfig
+    from realtime_fraud_detection_tpu.scoring import (
+        FraudScorer,
+        ScorerConfig,
+    )
+    from realtime_fraud_detection_tpu.scoring.pipeline import (
+        init_scoring_models,
+    )
+    from realtime_fraud_detection_tpu.sim.simulator import (
+        TransactionGenerator,
+    )
+
+    bc = BertConfig(vocab_size=512, hidden_size=16, num_layers=1,
+                    num_heads=2, intermediate_size=32,
+                    max_position_embeddings=32)
+    sc = ScorerConfig(graph_mode="typed", fanout=4, graph_fanout2=4,
+                      text_len=16, token_cache_entries=256)
+    models = init_scoring_models(jax.random.PRNGKey(0), bert_config=bc,
+                                 n_trees=4, tree_depth=3, gnn_typed=True)
+    gen = TransactionGenerator(num_users=120, num_merchants=24, seed=seed)
+    scorers = []
+    for _ in range(2):
+        s = FraudScorer(models=models, scorer_config=sc, bert_config=bc)
+        s.seed_profiles(gen.users.profiles(), gen.merchants.profiles())
+        scorers.append(s)
+    return gen, scorers
+
+
+class TestScorerGraphIntegration:
+    def test_finalize_ingests_ring_entities_one_seam(self):
+        """ISSUE 14 small fix: FraudRing's shared device_id/ip_address
+        flow into per-entity state at the finalize seam — identically
+        for both assemble paths (there is only ONE ingest site)."""
+        from realtime_fraud_detection_tpu.sim.fraud_patterns import (
+            FraudRingConfig,
+        )
+
+        gen, (scorer, _) = _typed_scorer_pair()
+        ring = gen.inject_fraud_ring(FraudRingConfig(rate=1.0,
+                                                     n_members=6,
+                                                     n_devices=2,
+                                                     n_ips=2))
+        recs = gen.generate_batch(16)
+        scorer.score_batch(recs, now=0.0)
+        users = scorer.typed_graph.neighbors("device->user",
+                                             ring.device_ids)
+        assert sum(len(u) for u in users) >= 2        # cohort visible
+        snap = scorer.graph_snapshot()
+        assert snap["mode"] == "typed"
+        assert snap["store"]["edges_added"] > 0
+
+    def test_columnar_equals_serial_with_graph_sampling(self):
+        """Acceptance: columnar==serial stays bit-exact with graph
+        sampling enabled — every ScoreBatch leaf AND every served
+        score."""
+        import jax
+
+        from realtime_fraud_detection_tpu.sim.fraud_patterns import (
+            FraudRingConfig,
+        )
+
+        gen, (col, ser) = _typed_scorer_pair()
+        gen.inject_fraud_ring(FraudRingConfig(rate=0.3))
+        for i in range(3):
+            recs = gen.generate_batch(16)
+            ts = float(i)
+            b_col = col.assemble(recs, now=ts)
+            b_ser = ser.assemble_serial(recs, now=ts)
+            la, ta = jax.tree_util.tree_flatten(b_col)
+            lb, tb = jax.tree_util.tree_flatten(b_ser)
+            assert ta == tb
+            for x, y in zip(la, lb):
+                assert np.array_equal(np.asarray(x), np.asarray(y))
+            r_col = col.finalize(col.dispatch_assembled(b_col, recs),
+                                 now=ts)
+            r_ser = ser.finalize(ser.dispatch_assembled(b_ser, recs),
+                                 now=ts)
+            for a, b in zip(r_col, r_ser):
+                assert a["fraud_score"] == b["fraud_score"]
+
+    def test_bipartite_mode_keeps_legacy_packspec(self):
+        """The 2-hop fields are absent (not empty) in bipartite mode:
+        the packed spec — a static jit arg — is unchanged with the graph
+        plane off."""
+        from realtime_fraud_detection_tpu.core.packing import pack_tree
+        from realtime_fraud_detection_tpu.scoring.pipeline import (
+            ScorerConfig,
+            make_example_batch,
+        )
+
+        batch = make_example_batch(4, ScorerConfig())
+        assert batch.user_neigh2_feat is None
+        _, spec = pack_tree(batch)
+        # 65 leaves exactly as before the graph plane (txn struct + 13)
+        assert len(spec.entries) == 65
+
+    def test_host_state_round_trips_typed_graph(self):
+        """Review regression pin: a scorer-LOCAL typed graph rides the
+        host-state checkpoint (snapshot/restore), and the restored
+        scorer's sampler reads the restored store (cache dropped)."""
+        from realtime_fraud_detection_tpu.checkpoint import (
+            restore_scorer_host_state,
+            snapshot_scorer_host_state,
+        )
+
+        gen, (a, b) = _typed_scorer_pair()
+        recs = gen.generate_batch(16)
+        a.score_batch(recs, now=0.0)
+        assert len(a.typed_graph) > 0
+        state = snapshot_scorer_host_state(a)
+        assert state["typed_graph"] is a.typed_graph
+        restore_scorer_host_state(b, pickle.loads(pickle.dumps(state)))
+        assert b.typed_graph.digest() == a.typed_graph.digest()
+        assert b._sampler.graph is b.typed_graph
+        # a PARTITION-bundle-backed graph is the handoff path's to carry,
+        # never the host-state blob's
+        from realtime_fraud_detection_tpu.cluster.partition import (
+            PartitionedStore,
+        )
+        from realtime_fraud_detection_tpu.scoring import (
+            FraudScorer,
+            ScorerConfig,
+        )
+
+        store = PartitionedStore(4)
+        for p in range(4):
+            store.acquire(p)
+        sharded = FraudScorer(
+            models=a.models, bert_config=a.bert_config,
+            scorer_config=ScorerConfig(graph_mode="typed", fanout=4,
+                                       graph_fanout2=4, text_len=16,
+                                       token_cache_entries=256),
+            stores=store)
+        assert snapshot_scorer_host_state(sharded)["typed_graph"] is None
+
+    def test_attach_graph_fetch_requires_typed(self):
+        import jax
+
+        from realtime_fraud_detection_tpu.models.bert import BertConfig
+        from realtime_fraud_detection_tpu.scoring import (
+            FraudScorer,
+            ScorerConfig,
+        )
+        from realtime_fraud_detection_tpu.scoring.pipeline import (
+            init_scoring_models,
+        )
+
+        bc = BertConfig(vocab_size=256, hidden_size=16, num_layers=1,
+                        num_heads=2, intermediate_size=32)
+        s = FraudScorer(models=init_scoring_models(
+            jax.random.PRNGKey(0), bert_config=bc, n_trees=4,
+            tree_depth=3), bert_config=bc, scorer_config=ScorerConfig())
+        with pytest.raises(ValueError, match="typed"):
+            s.attach_graph_fetch(object())
+
+
+# ---------------------------------------------------------------------------
+# sync_graph mirror
+# ---------------------------------------------------------------------------
+
+
+class TestSyncGraph:
+    def _snapshot(self, edges_added=5, hits=3, fetches=7):
+        return {
+            "mode": "typed",
+            "store": {"fanout": 8, "generation": 2,
+                      "edges_added": edges_added,
+                      "nodes": {"user": 4, "device": 2, "merchant": 3,
+                                "ip": 2},
+                      "edges": {"user->device": 4, "device->user": 4,
+                                "user->merchant": 5,
+                                "merchant->user": 5,
+                                "user->ip": 4, "ip->user": 4}},
+            "sampler": {"hits": hits, "misses": 2, "evictions": 1,
+                        "entries": 6},
+            "fetch": {"remote_fetch_total": fetches,
+                      "fetched_nodes_total": 30,
+                      "fetch_deadline_total": 1, "fetch_error_total": 2,
+                      "budget_exhausted_total": 0,
+                      "stale_generation_total": 1,
+                      "degraded_batches_total": 3},
+        }
+
+    def test_honest_deltas_idempotent(self):
+        from realtime_fraud_detection_tpu.obs.metrics import (
+            MetricsCollector,
+        )
+
+        m = MetricsCollector()
+        m.sync_graph(self._snapshot())
+        m.sync_graph(self._snapshot())                # same totals: no inc
+        assert m.graph_edges_added.total() == 5
+        assert m.graph_remote_fetch.total() == 7
+        m.sync_graph(self._snapshot(edges_added=9, hits=4, fetches=8))
+        assert m.graph_edges_added.total() == 9
+        assert m.graph_sampler_cache_hits.total() == 4
+        assert m.graph_remote_fetch.total() == 8
+
+    def test_stream_and_serving_render_identical(self):
+        from realtime_fraud_detection_tpu.obs.metrics import (
+            MetricsCollector,
+        )
+
+        def graph_lines(m):
+            return sorted(
+                line for line in m.render_prometheus().splitlines()
+                if "graph_" in line)
+
+        a, b = MetricsCollector(), MetricsCollector()
+        for snap in (self._snapshot(),
+                     self._snapshot(edges_added=9, hits=4, fetches=8)):
+            a.sync_graph(snap)
+            b.sync_graph(snap)
+        assert graph_lines(a) == graph_lines(b)
+
+    def test_bipartite_snapshot_sets_mode_only(self):
+        from realtime_fraud_detection_tpu.obs.metrics import (
+            MetricsCollector,
+        )
+
+        m = MetricsCollector()
+        m.sync_graph({"mode": "bipartite"})
+        assert m.graph_typed_mode.value() == 0.0
+        assert m.graph_edges_added.total() == 0
+
+
+# ---------------------------------------------------------------------------
+# drill smoke (tier-1, un-slow-marked)
+# ---------------------------------------------------------------------------
+
+
+def test_graph_drill_fast_smoke(capsys):
+    """Tier-1 acceptance: `rtfd graph-drill --fast` runs un-slow-marked
+    on every pass. Pins the whole graph-plane contract: typed graph +
+    two-hop sampling feeding the GNN across 2 partition workers,
+    ring-phase AUC lift over the trees-only incumbent, cross-partition
+    fetches exercised, netfault degrade window with zero lost scores,
+    columnar==serial bit-exact, digest-identical fresh second run."""
+    from realtime_fraud_detection_tpu import cli
+
+    rc = cli.main(["graph-drill", "--fast"])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    compact = json.loads(out[-1])               # final line: compact verdict
+    assert len(out[-1].encode()) < 2048
+    assert compact["passed"] is True
+    checks = compact["checks"]
+    assert checks["ring_auc_lift"] and checks["healthy_not_regressed"]
+    assert checks["ring_straddles_shards"]
+    assert checks["remote_fetch_exercised"]
+    assert checks["degrade_exercised_in_window"]
+    assert checks["no_degrade_before_window"]
+    assert checks["zero_lost"] and checks["every_txn_scored_once"]
+    assert checks["zero_errors"] and checks["offsets_gap_free"]
+    assert checks["columnar_serial_bitexact"]
+    assert checks["replay_bit_identical"]
+    full = json.loads(out[-2])                  # preceding line: full result
+    assert full["auc"]["ring_phase_lift"] >= 0.05
+    assert full["remote_fetches"] > 0 and full["lost"] == 0

@@ -501,7 +501,8 @@ class TestRegistrationAndSummary:
         )
 
         assert "partition-drill" in LOCKWATCH_DRILLS
-        assert len(LOCKWATCH_DRILLS) == 10
+        # eleven since ISSUE 14 added graph-drill
+        assert len(LOCKWATCH_DRILLS) == 11
 
     def test_netfaults_in_lint_scopes(self):
         from realtime_fraud_detection_tpu.analysis.lint import (
