@@ -273,6 +273,12 @@ def _compact_summary(result: dict) -> dict:
                  if isinstance(v, (int, float))), default=None),
         } if (qz := result.get("quantization") or {})
             and not qz.get("error") else None),
+        "kernel_fusion": ({
+            name: {"pallas_us": k.get("pallas_interpret_us_per_txn"),
+                   "xla_us": k.get("xla_reference_us_per_txn")}
+            for name, k in (kf.get("kernels") or {}).items()
+        } if (kf := result.get("kernel_fusion") or {})
+            and not kf.get("error") else None),
         "quality": ({"auc": quality.get("auc"),
                      "accuracy": quality.get("accuracy")}
                     if quality else None),
@@ -304,7 +310,7 @@ def _compact_summary(result: dict) -> dict:
                        "host_assembly", "mesh_scaling", "pool_scaling",
                        "autotune", "chaos", "degraded_network",
                        "graph_sampling", "shard_scaling",
-                       "elastic_scaling", "quantization",
+                       "elastic_scaling", "quantization", "kernel_fusion",
                        "latest_committed_tpu_capture",
                        "text_encoder", "error"):
             if compact.pop(victim, None) is not None:
@@ -1136,6 +1142,22 @@ def run_bench() -> None:
         _log(f'quantization stage done: '
              f'{ {k: v for k, v in (result.get("quantization") or {}).items() if not isinstance(v, (dict, list))} }')
 
+    # ------------------------------------------------ kernel-fusion stage
+    # Pallas kernel plane (ops/): per-kernel µs/txn interpret-vs-XLA-
+    # reference + the host finalize math the fused epilogue removes. CPU
+    # only — interpret mode is the CPU serving path and the calibration
+    # pulls weights host-side once; compiled on-chip numbers come from
+    # the --kernels relay switches.
+    if not on_tpu and remaining() > 30:
+        try:
+            _kernel_fusion_stage(result, models, sc, bert_config, it,
+                                 snapshot)
+        except Exception as e:  # noqa: BLE001
+            result["kernel_fusion"] = {
+                "error": f"{type(e).__name__}: {e}"[:200]}
+        _log(f'kernel-fusion stage done: '
+             f'{ {k: v for k, v in (result.get("kernel_fusion") or {}).items() if not isinstance(v, (dict, list))} }')
+
     # 3b. honest sequence lengths (VERDICT r3 missing-6): the reference
     # tokenizes at max_length 512 (bert_text_analyzer.py:201-202); seq 64
     # is the production truncation for short merchant/description strings.
@@ -1406,15 +1428,25 @@ def _pool_scaling_stage(result: dict, models, sc, bert_config,
     # configuration — so one relay window captures f32 and quantized
     # scaling side by side. Calibration pulls the f32 weights host-side
     # once, HERE, before any timed dispatch.
+    # --kernels (RTFD_BENCH_KERNELS): the same pool with the Pallas
+    # kernel plane on (fused dequant-matmul + fused epilogue + flash
+    # attention, the rtfd kernel-drill gated configuration); composes
+    # with --quant so one relay window captures all four corners.
     quantized = os.environ.get("RTFD_BENCH_QUANT") == "1"
-    if quantized:
+    kernels_on = os.environ.get("RTFD_BENCH_KERNELS") == "1"
+    if quantized or kernels_on:
         from realtime_fraud_detection_tpu.utils.config import (
             Config,
+            KernelSettings,
             QuantSettings,
         )
 
-        scorer = FraudScorer(Config(quant=QuantSettings.full()),
-                             models=models, scorer_config=sc,
+        cfg = Config()
+        if quantized:
+            cfg.quant = QuantSettings.full()
+        if kernels_on:
+            cfg.kernels = KernelSettings.full()
+        scorer = FraudScorer(cfg, models=models, scorer_config=sc,
                              bert_config=bert_config)
     else:
         scorer = FraudScorer(models=models, scorer_config=sc,
@@ -1459,6 +1491,7 @@ def _pool_scaling_stage(result: dict, models, sc, bert_config,
         "inflight_depth": depth,
         "n_devices": len(devices),
         "quantized": quantized,
+        "kernels": kernels_on,
         "single_device_txn_per_s": round(single_tp, 1),
     }
     if len(devices) == 1:
@@ -2148,6 +2181,130 @@ def _quantization_stage(result: dict, models, sc, bert_config,
     snapshot("quantization")
 
 
+def _kernel_fusion_stage(result: dict, models, sc, bert_config, it,
+                         snapshot) -> None:
+    """Pallas kernel plane (ISSUE 17 bench stage): per-kernel µs/txn,
+    interpret-mode Pallas vs the XLA reference lowering, plus the host
+    math the fused epilogue removes from finalize.
+
+    CPU only and pre-pull-safe by construction: every timed callable
+    keeps its output on device (time_blocked's block_until_ready is the
+    only sync), inputs are varied per iteration, and the int8
+    calibration pulls weights host-side once before any timed section.
+    The interpret numbers are a CORRECTNESS-cost record (the Pallas
+    interpreter is expected to lose to XLA on CPU) — the on-chip compiled
+    numbers come from the ``--kernels`` relay switches on tune_tpu.py /
+    soak_tpu.py / this bench's pool_scaling stage. The pass/fail bar
+    lives in ``rtfd kernel-drill``.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from realtime_fraud_detection_tpu.ensemble.combine import EnsembleParams
+    from realtime_fraud_detection_tpu.models.quant import (
+        quantize_bert_params,
+    )
+    from realtime_fraud_detection_tpu.ops import (
+        attention_reference,
+        dequant_matmul,
+        dequant_matmul_reference,
+        dequant_rows,
+        dequant_rows_reference,
+        epilogue_reference,
+        flash_attention,
+        fused_epilogue,
+    )
+    from realtime_fraud_detection_tpu.scoring import MODEL_NAMES
+    from realtime_fraud_detection_tpu.utils.config import Config
+
+    batch, K = 128, 4
+    rng = np.random.default_rng(29)
+    # rtfd-lint: allow[d2h] host-side int8 calibration by contract (CPU-only stage, before any timed section)
+    qbert = jax.device_put(quantize_bert_params(jax.device_get(models.bert)))
+    layer = qbert["layers"][0]
+    h = bert_config.hidden_size
+    entry: dict = {"batch": batch}
+    kernels: dict = {}
+
+    def per_txn(fn, iters, n_txn):
+        return round(float(np.median(_time_blocked(fn, iters)))
+                     / n_txn * 1e6, 3)
+
+    # fused dequant-matmul on the served int8 q projection (bf16 compute)
+    xs = [jnp.asarray(rng.standard_normal((batch, h)), jnp.float32)
+          for _ in range(K)]
+    p = layer["q"]
+    ref_mm = jax.jit(lambda x: dequant_matmul_reference(
+        x, p["qw"], p["scale"], p["b"]))
+    iters = it(60)
+    kernels["dequant_matmul"] = {
+        "pallas_interpret_us_per_txn": per_txn(
+            lambda i: dequant_matmul(xs[i % K], p["qw"], p["scale"],
+                                     p["b"], interpret=True),
+            iters, batch),
+        "xla_reference_us_per_txn": per_txn(
+            lambda i: ref_mm(xs[i % K]), iters, batch),
+    }
+
+    # per-row embedding dequant on served word_emb rows
+    emb = qbert["word_emb"]
+    rows = 256
+    idxs = [jnp.asarray(rng.integers(0, emb["qe"].shape[0], (rows,)))
+            for _ in range(K)]
+    ref_rows = jax.jit(lambda q, s: dequant_rows_reference(q, s))
+    kernels["dequant_rows"] = {
+        "pallas_interpret_us_per_txn": per_txn(
+            lambda i: dequant_rows(emb["qe"][idxs[i % K]],
+                                   emb["scale"][idxs[i % K]],
+                                   interpret=True), iters, rows),
+        "xla_reference_us_per_txn": per_txn(
+            lambda i: ref_rows(emb["qe"][idxs[i % K]],
+                               emb["scale"][idxs[i % K]]), iters, rows),
+    }
+
+    # fused score-and-blend epilogue vs the XLA combine+ladder reference
+    m = len(MODEL_NAMES)
+    params = EnsembleParams.from_config(Config(), list(MODEL_NAMES))
+    preds = [jnp.asarray(rng.uniform(0, 1, (batch, m)), jnp.float32)
+             for _ in range(K)]
+    valid = jnp.ones((batch, m), bool)
+    rules = [jnp.asarray(rng.uniform(0, 1, (batch,)), jnp.float32)
+             for _ in range(K)]
+    ref_ep = jax.jit(lambda pr, r: epilogue_reference(pr, valid, r, params))
+    kernels["epilogue"] = {
+        "pallas_interpret_us_per_txn": per_txn(
+            lambda i: fused_epilogue(preds[i % K], valid, rules[i % K],
+                                     params, interpret=True), iters, batch),
+        "xla_reference_us_per_txn": per_txn(
+            lambda i: ref_ep(preds[i % K], rules[i % K]), iters, batch),
+        # what the fusion removes from FraudScorer.finalize: the per-batch
+        # host numpy blend math (weights*preds contributions [B,M] f32 +
+        # the nested rules-only decision/risk ladders, ~4 [B] f32
+        # temporaries) moves inside the fused program's device_wait
+        "host_math_bytes_saved_per_batch": batch * (m + 4) * 4,
+        "extra_packed_cols_shipped": m + 2,
+    }
+
+    # flash attention vs the full-softmax reference at the drill shape
+    heads, d = bert_config.num_heads, bert_config.head_dim
+    s = sc.text_len
+    ab = 8
+    qkvs = [[jnp.asarray(rng.standard_normal((ab, heads, s, d)),
+                         jnp.float32) for _ in range(3)] for _ in range(K)]
+    amask = jnp.ones((ab, s), bool)
+    ref_att = jax.jit(lambda q, k, v: attention_reference(q, k, v, amask))
+    kernels["attention"] = {
+        "pallas_interpret_us_per_txn": per_txn(
+            lambda i: flash_attention(*qkvs[i % K], amask, interpret=True),
+            iters, ab),
+        "xla_reference_us_per_txn": per_txn(
+            lambda i: ref_att(*qkvs[i % K]), iters, ab),
+    }
+    entry["kernels"] = kernels
+    result["kernel_fusion"] = entry
+    snapshot("kernel_fusion")
+
+
 def _e2e_soak(result: dict, models, sc, bert_config, use_pallas: bool,
               on_tpu: bool, remaining, snapshot) -> None:
     """The whole-framework StreamJob soak + measured detection quality."""
@@ -2322,6 +2479,10 @@ def main() -> None:
         # mesh_scaling on a tunneled TPU (always-on for CPU runs);
         # propagates to the inner process through the inherited env
         os.environ["RTFD_BENCH_MESH"] = "1"
+    if "--kernels" in sys.argv:
+        # kernel-plane pool_scaling (the rtfd kernel-drill gated config);
+        # propagates to the inner process through the inherited env
+        os.environ["RTFD_BENCH_KERNELS"] = "1"
     orchestrate()
 
 
@@ -2330,6 +2491,8 @@ if __name__ == "__main__":
         os.environ["RTFD_BENCH_QUANT"] = "1"
     if "--mesh" in sys.argv:
         os.environ["RTFD_BENCH_MESH"] = "1"
+    if "--kernels" in sys.argv:
+        os.environ["RTFD_BENCH_KERNELS"] = "1"
     if "--inner" in sys.argv:
         run_bench()
     else:

@@ -114,6 +114,14 @@ D2H_MODULES = frozenset({
     # is an oracle harness like pool_drill, which is already here).
     "scoring/mesh_executor.py",
     "scoring/mesh_drill.py",
+    # Pallas kernel plane (ISSUE 17): kernel wrappers sit directly inside
+    # the fused dispatch program — any host pull there would stall every
+    # launch, so all three modules carry the full-module d2h contract.
+    # (scoring/kernel_drill.py rides the *drill* determinism convention
+    # and is an oracle harness like quant_drill, deliberately NOT here.)
+    "ops/attention.py",
+    "ops/dequant_matmul.py",
+    "ops/epilogue.py",
 })
 # Function-scoped d2h contract: the scorer's dispatch half must stay
 # pull-free (finalize is the designated pull point).
@@ -143,6 +151,10 @@ DETERMINISM_SUBSYSTEMS = frozenset({
     # graph-drill's digest-identical fresh second run requires every
     # module to be a pure function of its inputs (seeded rng only)
     "graph",
+    # Pallas kernel plane (ISSUE 17): kernels must be pure functions of
+    # their operands or kernel-drill's parity digest lies — no hidden RNG
+    # (tie-breaking, dropout-style noise) may ever enter a kernel wrapper
+    "ops",
 })
 
 # Param / degradation-mask mutators: reachable only under the score lock

@@ -46,11 +46,11 @@ _REAL_CONDITION = threading.Condition
 
 PACKAGE_MARKER = "realtime_fraud_detection_tpu"
 
-# the eleven deterministic drills the watcher is validated against
+# the twelve deterministic drills the watcher is validated against
 LOCKWATCH_DRILLS = ("qos-drill", "trace-drill", "autotune-drill",
                     "feedback-drill", "pool-drill", "chaos-drill",
                     "shard-drill", "mesh-drill", "elastic-drill",
-                    "partition-drill", "graph-drill")
+                    "partition-drill", "graph-drill", "kernel-drill")
 
 
 class LockWatcher:
@@ -381,8 +381,8 @@ def run_drill_watched(drill: str, fast: bool = True,
     pool-drill, chaos-drill and mesh-drill need a multi-device host
     platform — callers (the ``rtfd lint --lockwatch`` parent) re-exec
     them into a child with
-    ``XLA_FLAGS=--xla_force_host_platform_device_count=N``; the other
-    five run on whatever platform is live.
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=N``; the rest
+    run on whatever platform is live.
     """
     import contextlib
     import io
@@ -516,7 +516,7 @@ def run_drill_watched(drill: str, fast: bool = True,
                     else PartitionDrillConfig(),
                     replay_check=False)
                 passed = bool(run_partition_drill(cfg)["passed"])
-            else:   # graph-drill
+            elif drill == "graph-drill":
                 import dataclasses
 
                 from realtime_fraud_detection_tpu.graph.drill import (
@@ -534,4 +534,21 @@ def run_drill_watched(drill: str, fast: bool = True,
                     else GraphDrillConfig(),
                     replay_check=False)
                 passed = bool(run_graph_drill(cfg)["passed"])
+            else:   # kernel-drill
+                import dataclasses
+
+                from realtime_fraud_detection_tpu.scoring.kernel_drill import (
+                    KernelDrillConfig,
+                    run_kernel_drill,
+                )
+
+                # single pass (replay is the drill's OWN acceptance gate;
+                # under the watcher it would only double the wall time) —
+                # both scorer sides dispatch through the real score lock,
+                # so the kernel-on path is exercised under instrumentation
+                cfg = dataclasses.replace(
+                    KernelDrillConfig.fast() if fast
+                    else KernelDrillConfig(),
+                    replay=False)
+                passed = bool(run_kernel_drill(cfg)["passed"])
     return {"drill": drill, "drill_passed": passed, "lockwatch": w.report()}

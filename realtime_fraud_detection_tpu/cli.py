@@ -145,15 +145,24 @@ def cmd_run_job(args: argparse.Namespace) -> int:
         shost, sport = _addr(args.state, 6379)
         state_client = RespClient(host=shost, port=sport)
     job_config_obj = None
-    if getattr(args, "quant", False):
-        # quantized scoring plane (models/quant.py): int8 BERT weights +
-        # GEMM-form tree kernels, the configuration rtfd quant-drill gates
+    if getattr(args, "quant", False) or getattr(args, "kernels", False):
         from realtime_fraud_detection_tpu.utils.config import (
             Config,
+            KernelSettings,
             QuantSettings,
         )
 
-        job_config_obj = Config(quant=QuantSettings.full())
+        job_config_obj = Config()
+        if getattr(args, "quant", False):
+            # quantized scoring plane (models/quant.py): int8 BERT weights
+            # + GEMM-form tree kernels, the configuration rtfd quant-drill
+            # gates
+            job_config_obj.quant = QuantSettings.full()
+        if getattr(args, "kernels", False):
+            # Pallas kernel plane (ops/): fused dequant-matmul + fused
+            # score-and-blend epilogue + flash attention, the
+            # configuration rtfd kernel-drill gates
+            job_config_obj.kernels = KernelSettings.full()
     scorer = FraudScorer(job_config_obj, scorer_config=ScorerConfig(),
                          state_client=state_client)
     scorer.seed_profiles(gen.users.profiles(), gen.merchants.profiles())
@@ -378,6 +387,10 @@ def cmd_serve(args: argparse.Namespace) -> int:
         from realtime_fraud_detection_tpu.utils.config import QuantSettings
 
         config.quant = QuantSettings.full()
+    if getattr(args, "kernels", False):
+        from realtime_fraud_detection_tpu.utils.config import KernelSettings
+
+        config.kernels = KernelSettings.full()
     if getattr(args, "autotune", False):
         config.tuning.enabled = True
         # clamp the tuner's deadline search space to the budget's
@@ -683,6 +696,10 @@ def cmd_bench(args: argparse.Namespace) -> int:
         # mesh_scaling on a tunneled TPU (bench.py reads the env in the
         # inner process; see _mesh_scaling_stage — CPU runs it always)
         os.environ["RTFD_BENCH_MESH"] = "1"
+    if getattr(args, "kernels", False):
+        # kernel-plane pool_scaling (bench.py reads the env in the inner
+        # process; see _pool_scaling_stage)
+        os.environ["RTFD_BENCH_KERNELS"] = "1"
     bench.main()
     return 0
 
@@ -963,6 +980,36 @@ def cmd_quant_drill(args: argparse.Namespace) -> int:
     summary = run_quant_drill(cfg)
     print(json.dumps(summary), flush=True)
     print(json.dumps(compact_quant_summary(summary),
+                     separators=(",", ":")), flush=True)
+    return 0 if summary["passed"] else 1
+
+
+def cmd_kernel_drill(args: argparse.Namespace) -> int:
+    """Deterministic kernel drill (scoring/kernel_drill.py): the parity
+    oracle gating the Pallas kernel plane. One seeded stream through two
+    quantized fused programs — stock XLA lowering vs every kernel on
+    (fused dequant-matmul + fused score-and-blend epilogue + flash
+    attention): max score divergence pinned below the measured
+    calibration-noise floor, zero decision flips, exact masked-blend
+    equality at every QoS ladder rung, per-kernel interpret-vs-reference
+    parity on the served params, zero guard fallbacks, and a bit-identical
+    second run. Prints the full summary, then a compact (<2 KB) verdict
+    as the FINAL stdout line (bench.py convention). Exit 1 unless every
+    check passed."""
+    import dataclasses as _dc
+
+    from realtime_fraud_detection_tpu.scoring.kernel_drill import (
+        KernelDrillConfig,
+        compact_kernel_summary,
+        run_kernel_drill,
+    )
+
+    cfg = KernelDrillConfig.fast() if args.fast else KernelDrillConfig()
+    cfg = _dc.replace(cfg, seed=args.seed,
+                      replay=not getattr(args, "no_replay", False))
+    summary = run_kernel_drill(cfg)
+    print(json.dumps(summary), flush=True)
+    print(json.dumps(compact_kernel_summary(summary),
                      separators=(",", ":")), flush=True)
     return 0 if summary["passed"] else 1
 
@@ -1423,7 +1470,7 @@ def cmd_graph_drill(args: argparse.Namespace) -> int:
 
 def cmd_lint(args: argparse.Namespace) -> int:
     """Run the repo-native invariant checker (analysis/lint.py) — or, with
-    --lockwatch, the dynamic lock-order watcher under all eleven
+    --lockwatch, the dynamic lock-order watcher under all twelve
     deterministic drills (analysis/lockwatch.py). Exit 0 only when clean.
 
     The static rules (wall-clock, d2h, metrics, lock-order, determinism,
@@ -1662,6 +1709,11 @@ def build_parser() -> argparse.ArgumentParser:
                     help="quantized scoring plane (models/quant.py): "
                          "weight-only int8 BERT + GEMM-form tree kernels "
                          "(the rtfd quant-drill gated configuration)")
+    sp.add_argument("--kernels", action="store_true",
+                    help="Pallas kernel plane (ops/): fused dequant-"
+                         "matmul + fused score-and-blend epilogue + flash "
+                         "attention (the rtfd kernel-drill gated "
+                         "configuration)")
     sp.set_defaults(fn=cmd_run_job)
 
     sp = sub.add_parser("serve", help="run the scoring HTTP service")
@@ -1705,6 +1757,11 @@ def build_parser() -> argparse.ArgumentParser:
                     help="quantized scoring plane (models/quant.py): "
                          "weight-only int8 BERT + GEMM-form tree kernels "
                          "(the rtfd quant-drill gated configuration)")
+    sp.add_argument("--kernels", action="store_true",
+                    help="Pallas kernel plane (ops/): fused dequant-"
+                         "matmul + fused score-and-blend epilogue + flash "
+                         "attention (the rtfd kernel-drill gated "
+                         "configuration)")
     sp.add_argument("--trace", action="store_true",
                     help="enable the per-transaction tracing plane: "
                          "GET /latency/breakdown, GET /slo, trace_* "
@@ -1893,6 +1950,22 @@ def build_parser() -> argparse.ArgumentParser:
                          "stage mode; the replay gate is waived)")
     sp.set_defaults(fn=cmd_quant_drill)
 
+    sp = sub.add_parser("kernel-drill",
+                        help="deterministic kernel drill (parity oracle): "
+                             "the Pallas kernel plane vs the stock XLA "
+                             "lowering — divergence below calibration "
+                             "noise, zero decision flips, exact masked-"
+                             "blend equality at every QoS rung, per-"
+                             "kernel interpret-vs-reference parity, bit-"
+                             "identical replay")
+    sp.add_argument("--fast", action="store_true",
+                    help="tier-1 sizes (the CI smoke configuration)")
+    sp.add_argument("--seed", type=int, default=13)
+    sp.add_argument("--no-replay", action="store_true",
+                    help="skip the bit-identical second run (bench "
+                         "stage mode; the replay gate is waived)")
+    sp.set_defaults(fn=cmd_kernel_drill)
+
     sp = sub.add_parser("trace-export",
                         help="run a traced fake-Kafka job and export "
                              "Chrome-trace/Perfetto JSON")
@@ -2026,7 +2099,7 @@ def build_parser() -> argparse.ArgumentParser:
                          "+ bench.py)")
     sp.add_argument("--format", choices=("text", "json"), default="text")
     sp.add_argument("--lockwatch", action="store_true",
-                    help="run the eleven deterministic drills under the "
+                    help="run the twelve deterministic drills under the "
                          "instrumented lock watcher instead of the static "
                          "rules")
     sp.add_argument("--lockwatch-run", default="",
@@ -2047,6 +2120,11 @@ def build_parser() -> argparse.ArgumentParser:
                          "TPU too (replicated vs data-sharded vs "
                          "data x model + per-chip param bytes); CPU runs "
                          "it unconditionally")
+    sp.add_argument("--kernels", action="store_true",
+                    help="measure the pool_scaling stage on the Pallas "
+                         "kernel plane too (fused dequant-matmul + fused "
+                         "epilogue + flash attention; labels suffixed "
+                         "-kern)")
     sp.set_defaults(fn=cmd_bench)
 
     sp = sub.add_parser("health-check", help="probe a running service")

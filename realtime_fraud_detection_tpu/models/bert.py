@@ -27,6 +27,12 @@ from realtime_fraud_detection_tpu.ops.attention import (
     attention_reference,
     flash_attention,
 )
+from realtime_fraud_detection_tpu.ops.dequant_matmul import (
+    dequant_matmul,
+    dequant_rows,
+    matmul_supported,
+    rows_supported,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -97,8 +103,21 @@ def _layer_norm(x, p, eps):
     return ((x32 - mu) * jax.lax.rsqrt(var + eps) * p["scale"] + p["bias"])
 
 
-def _dense(x, p, compute_dtype):
+def _dense(x, p, compute_dtype, dequant_kernel="off", kernel_interpret=False):
     if "qw" in p:
+        if dequant_kernel == "pallas":
+            # hand-fused Pallas path (ops/dequant_matmul.py): the i8 weight
+            # block dequantizes in VMEM right before the MXU dot, guarded
+            # by the SAME supports() predicate the scorer's fallback
+            # counters consult
+            lead = x.shape[:-1]
+            k, n = p["qw"].shape
+            m = int(np.prod(lead)) if lead else 1
+            if matmul_supported(m, k, n):
+                y = dequant_matmul(
+                    x.reshape(m, k), p["qw"], p["scale"], p["b"],
+                    compute_dtype=compute_dtype, interpret=kernel_interpret)
+                return y.reshape(*lead, n)
         # weight-only int8 (models/quant.py): dequantize per-output-channel
         # right at the compute-dtype seam — XLA fuses the (i8 -> bf16) *
         # scale widen into the matmul's weight read, so the full-precision
@@ -108,17 +127,28 @@ def _dense(x, p, compute_dtype):
     return x.astype(compute_dtype) @ p["w"].astype(compute_dtype) + p["b"]
 
 
-def _embedding_rows(table, idx=None, length=None):
+def _embedding_rows(table, idx=None, length=None, dequant_kernel="off",
+                    kernel_interpret=False):
     """Embedding lookup that understands both layouts: a bare f32 table,
     or the quantized ``{"qe": i8[rows, h], "scale": f32[rows]}`` form
     (per-row scales — the gather's output channel is the row). Returns
     f32 rows either way; ``idx`` gathers, ``length`` slices a prefix."""
     if isinstance(table, dict) and "qe" in table:
         if idx is not None:
-            return (table["qe"][idx].astype(jnp.float32)
-                    * table["scale"][idx][..., None])
-        return (table["qe"][:length].astype(jnp.float32)
-                * table["scale"][:length][:, None])
+            q, s = table["qe"][idx], table["scale"][idx]
+        else:
+            q, s = table["qe"][:length], table["scale"][:length]
+        if dequant_kernel == "pallas":
+            # the arbitrary-index gather stays an XLA i8 gather; the
+            # per-row widen x scale runs through the Pallas kernel so only
+            # i8 rows cross HBM at full width
+            h = q.shape[-1]
+            rows = int(np.prod(q.shape[:-1]))
+            if rows_supported(rows, h):
+                out = dequant_rows(q.reshape(rows, h), s.reshape(rows),
+                                   interpret=kernel_interpret)
+                return out.reshape(*q.shape[:-1], h)
+        return q.astype(jnp.float32) * s[..., None]
     return table[idx] if idx is not None else table[:length]
 
 
@@ -130,6 +160,8 @@ def bert_encode(
     use_pallas: bool = False,
     compute_dtype=jnp.bfloat16,
     attention_fn=None,
+    dequant_kernel: str = "off",
+    kernel_interpret: bool = False,
 ) -> jax.Array:
     """Hidden states f32[B, S, H].
 
@@ -138,22 +170,36 @@ def bert_encode(
     (``parallel.context.bert_context_parallel_predict`` passes ring
     attention here; everything else in the layer is per-token and shards
     along S for free).
+
+    ``dequant_kernel``/``kernel_interpret`` select the hand-fused Pallas
+    dequant path for int8 params (ops/dequant_matmul.py, KernelSettings);
+    both are static and only consulted where the quantized layout is
+    structurally present.
     """
-    x = bert_embed(params, input_ids, config)
+    x = bert_embed(params, input_ids, config,
+                   dequant_kernel=dequant_kernel,
+                   kernel_interpret=kernel_interpret)
     for layer in params["layers"]:
         x = bert_layer(layer, x, attention_mask, config,
                        use_pallas=use_pallas, compute_dtype=compute_dtype,
-                       attention_fn=attention_fn)
+                       attention_fn=attention_fn,
+                       dequant_kernel=dequant_kernel,
+                       kernel_interpret=kernel_interpret)
     return x
 
 
 def bert_embed(params: Dict, input_ids: jax.Array,
-               config: BertConfig) -> jax.Array:
+               config: BertConfig, dequant_kernel: str = "off",
+               kernel_interpret: bool = False) -> jax.Array:
     """Token + position embeddings with the embedding layer norm — shared
     by the sequential and pipeline-parallel encoders."""
     s = input_ids.shape[1]
-    x = (_embedding_rows(params["word_emb"], idx=input_ids)
-         + _embedding_rows(params["pos_emb"], length=s)[None, :, :])
+    x = (_embedding_rows(params["word_emb"], idx=input_ids,
+                         dequant_kernel=dequant_kernel,
+                         kernel_interpret=kernel_interpret)
+         + _embedding_rows(params["pos_emb"], length=s,
+                           dequant_kernel=dequant_kernel,
+                           kernel_interpret=kernel_interpret)[None, :, :])
     return _layer_norm(x, params["emb_ln"], config.layer_norm_eps)
 
 
@@ -165,13 +211,16 @@ def bert_layer(
     use_pallas: bool = False,
     compute_dtype=jnp.bfloat16,
     attention_fn=None,
+    dequant_kernel: str = "off",
+    kernel_interpret: bool = False,
 ) -> jax.Array:
     """One post-LN transformer block — the unit the pipeline-parallel
     schedule (parallel/pipeline.bert_pipeline_encode) spans over stages."""
     b, s = x.shape[:2]
-    q = _dense(x, layer["q"], compute_dtype)
-    k = _dense(x, layer["k"], compute_dtype)
-    v = _dense(x, layer["v"], compute_dtype)
+    dk = dict(dequant_kernel=dequant_kernel, kernel_interpret=kernel_interpret)
+    q = _dense(x, layer["q"], compute_dtype, **dk)
+    k = _dense(x, layer["k"], compute_dtype, **dk)
+    v = _dense(x, layer["v"], compute_dtype, **dk)
 
     def split(t):
         return t.reshape(b, s, config.num_heads,
@@ -181,15 +230,16 @@ def bert_layer(
     if attention_fn is not None:
         ctx = attention_fn(qh, kh, vh, attention_mask)
     elif use_pallas:
-        ctx = flash_attention(qh, kh, vh, attention_mask)
+        ctx = flash_attention(qh, kh, vh, attention_mask,
+                              interpret=kernel_interpret)
     else:
         ctx = attention_reference(qh, kh, vh, attention_mask)
     ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, config.hidden_size)
-    attn_out = _dense(ctx, layer["o"], compute_dtype)
+    attn_out = _dense(ctx, layer["o"], compute_dtype, **dk)
     x = _layer_norm(x + attn_out, layer["attn_ln"], config.layer_norm_eps)
 
-    ffn = _dense(jax.nn.gelu(_dense(x, layer["ffn1"], compute_dtype)),
-                 layer["ffn2"], compute_dtype)
+    ffn = _dense(jax.nn.gelu(_dense(x, layer["ffn1"], compute_dtype, **dk)),
+                 layer["ffn2"], compute_dtype, **dk)
     return _layer_norm(x + ffn, layer["ffn_ln"], config.layer_norm_eps)
 
 
@@ -201,11 +251,15 @@ def bert_logits(
     use_pallas: bool = False,
     compute_dtype=jnp.bfloat16,
     attention_fn=None,
+    dequant_kernel: str = "off",
+    kernel_interpret: bool = False,
 ) -> jax.Array:
     """Sequence-classification logits f32[B, num_labels] from [CLS]."""
     hidden = bert_encode(params, input_ids, attention_mask, config,
                          use_pallas, compute_dtype=compute_dtype,
-                         attention_fn=attention_fn)
+                         attention_fn=attention_fn,
+                         dequant_kernel=dequant_kernel,
+                         kernel_interpret=kernel_interpret)
     cls = hidden[:, 0, :]
     z = jax.nn.relu(cls @ params["pre_classifier"]["w"] + params["pre_classifier"]["b"])
     return z @ params["classifier"]["w"] + params["classifier"]["b"]
@@ -219,6 +273,8 @@ def bert_predict(
     use_pallas: bool = False,
     compute_dtype=jnp.bfloat16,
     attention_fn=None,
+    dequant_kernel: str = "off",
+    kernel_interpret: bool = False,
 ) -> jax.Array:
     """Fraud probability f32[B] = softmax(logits)[:, 1]
     (bert_text_analyzer.py:216-222).
@@ -228,5 +284,7 @@ def bert_predict(
     committed bf16 policy already accepts."""
     logits = bert_logits(params, input_ids, attention_mask, config,
                          use_pallas, compute_dtype=compute_dtype,
-                         attention_fn=attention_fn)
+                         attention_fn=attention_fn,
+                         dequant_kernel=dequant_kernel,
+                         kernel_interpret=kernel_interpret)
     return jax.nn.softmax(logits, axis=-1)[:, 1]

@@ -563,6 +563,33 @@ class MetricsCollector:
             "(rtfd quant-drill and any caller running the quantized-vs-"
             "f32 comparison)", ("verdict",))
         self._quant_seen: Dict[str, float] = {}
+        # Pallas kernel plane (ops/ + KernelSettings): per-site effective
+        # modes as exhaustive 0/1 gauges (the quant_branch_mode
+        # discipline — a swap reads as a transition, not a new series),
+        # whether the interpreter is serving (non-TPU hosts), and honest
+        # per-site dispatch/fallback counters mirrored from
+        # FraudScorer.kernel_snapshot by sync_kernels at exposition time
+        self.kernel_site_mode = r.gauge(
+            "kernel_site_mode",
+            "1 for the kernel mode each fusion site currently serves "
+            "(off/pallas for dequant_matmul and epilogue, "
+            "reference/flash for attention)",
+            ("site", "mode"))
+        self.kernel_interpret = r.gauge(
+            "kernel_interpret_active",
+            "1 when the kernel plane is serving through the Pallas "
+            "interpreter (non-TPU host) rather than compiled kernels")
+        self.kernel_dispatches = r.counter(
+            "kernel_dispatch_total",
+            "Batches dispatched with this site's Pallas kernel engaged",
+            ("site",))
+        self.kernel_fallbacks = r.counter(
+            "kernel_fallback_total",
+            "Batches where this site's kernel was requested but the "
+            "shape/param-form guard fell back to the XLA lowering",
+            ("site",))
+        self._kernel_seen: Dict[str, Dict[str, float]] = {
+            "dispatch": {}, "fallback": {}}
         # partition-parallel worker plane (cluster/): fleet membership,
         # partition ownership, checkpointed-handoff accounting, and the
         # serving router's key-movement ledger — mirrored from
@@ -1009,6 +1036,41 @@ class MetricsCollector:
             if delta > 0:
                 self.quant_gate_verdicts.inc(delta, verdict=str(verdict))
             self._quant_seen[verdict] = float(total)
+
+    def sync_kernels(self, snapshot: Mapping[str, Any]) -> None:
+        """Mirror a ``FraudScorer.kernel_snapshot()`` into the kernel_*
+        series. Called at exposition time; per-site mode gauges are
+        exhaustive over the valid modes (an off site reads mode="off"=1,
+        so a kernel swap is a visible transition, never a new series),
+        and the scorer's cumulative dispatch/fallback counts mirror as
+        counter DELTAS against last-seen values — the honest-counter
+        scheme every sync_* mirror here uses — so a stream job and a
+        serving app syncing the same snapshot render IDENTICAL series."""
+        from realtime_fraud_detection_tpu.utils.config import (
+            VALID_ATTENTION_KERNELS,
+            VALID_KERNEL_MODES,
+            VALID_KERNEL_SITES,
+        )
+
+        modes = snapshot.get("modes") or {}
+        for site in VALID_KERNEL_SITES:
+            served = modes.get(site)
+            valid = (VALID_ATTENTION_KERNELS if site == "attention"
+                     else VALID_KERNEL_MODES)
+            for mode in valid:
+                self.kernel_site_mode.set(
+                    1.0 if mode == served else 0.0,
+                    site=str(site), mode=str(mode))
+        self.kernel_interpret.set(
+            1.0 if snapshot.get("interpret") else 0.0)
+        for kind, counter in (("dispatch", self.kernel_dispatches),
+                              ("fallback", self.kernel_fallbacks)):
+            seen = self._kernel_seen[kind]
+            for site, total in (snapshot.get(kind) or {}).items():
+                delta = float(total) - seen.get(site, 0.0)
+                if delta > 0:
+                    counter.inc(delta, site=str(site))
+                seen[site] = float(total)
 
     def sync_mesh(self, snapshot: Mapping[str, Any]) -> None:
         """Mirror a ``MeshExecutor.mesh_snapshot()`` into the mesh_*

@@ -2,3 +2,16 @@ from realtime_fraud_detection_tpu.ops.attention import (  # noqa: F401
     flash_attention,
     attention_reference,
 )
+from realtime_fraud_detection_tpu.ops.dequant_matmul import (  # noqa: F401
+    dequant_matmul,
+    dequant_matmul_reference,
+    dequant_rows,
+    dequant_rows_reference,
+    matmul_supported,
+    rows_supported,
+)
+from realtime_fraud_detection_tpu.ops.epilogue import (  # noqa: F401
+    epilogue_reference,
+    epilogue_supported,
+    fused_epilogue,
+)

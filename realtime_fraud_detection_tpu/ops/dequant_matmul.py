@@ -1,0 +1,167 @@
+"""Fused int8 dequant-matmul Pallas kernels for the quantized BERT branch.
+
+The weight-only int8 layout (models/quant.py) stores every dense kernel as
+``{"qw": i8[K, N], "scale": f32[N], "b": f32[N]}`` (per-output-channel
+scales) and the embedding tables as ``{"qe": i8[rows, H], "scale": f32[rows]}``
+(per-row scales). The XLA path in models/bert.py:_dense widens the weight
+``(i8 -> compute_dtype) * scale`` and trusts the compiler to fuse that read
+into the matmul; these kernels make the fusion explicit so the widened
+kernel never exists outside VMEM — the MXU streams i8 weight blocks and
+dequantizes in registers right before the dot.
+
+Both kernels keep the XLA expressions as their numerics oracle
+(``dequant_matmul_reference`` / ``dequant_rows_reference`` are verbatim the
+bert.py math) and carry a shared ``*_supported`` shape predicate: the traced
+code consults it to fall back to XLA on hostile shapes, and the scorer's
+host-side dispatch counters consult the SAME predicate so the
+``kernel_fallback_total`` series stays honest.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+# Full-K blocks: every _dense site in the text encoder has K in {H, FFN}
+# (128/256 tiny, 768/3072 full-size) — small enough to stream whole columns
+# through VMEM. Cap guards the full-size FFN plus headroom.
+_MAX_FULL_K = 4096
+# Whole-array cap for the elementwise row-dequant kernel (elements).
+_MAX_ROWS_ELEMS = 1 << 21
+
+_BLOCK_M_CANDIDATES = (128, 64, 32, 16, 8)
+
+
+def _pick_block_m(m: int) -> int:
+    for cand in _BLOCK_M_CANDIDATES:
+        if m % cand == 0:
+            return cand
+    return 0
+
+
+def matmul_supported(m: int, k: int, n: int) -> bool:
+    """True when the fused dequant-matmul kernel handles [m,k]@[k,n].
+
+    Requirements: lane-aligned N (the i8 weight tile is (32, 128)), a
+    VMEM-resident K, and an M divisible by one of the row-block sizes.
+    Shared by the trace-time guard in models/bert.py and the host-side
+    fallback counting in FraudScorer.dispatch_assembled.
+    """
+    return (n % 128 == 0 and k % 128 == 0 and k <= _MAX_FULL_K
+            and _pick_block_m(m) > 0)
+
+
+def rows_supported(rows: int, h: int) -> bool:
+    """True when the per-row dequant kernel handles an [rows, h] gather
+    result: i8-tile-aligned rows, lane-aligned H, whole array in VMEM."""
+    return (rows % 32 == 0 and h % 128 == 0
+            and rows * h <= _MAX_ROWS_ELEMS)
+
+
+def dequant_matmul_reference(x, qw, scale, b, compute_dtype=jnp.bfloat16):
+    """XLA oracle — verbatim the models/bert.py:_dense int8 branch."""
+    w = qw.astype(compute_dtype) * scale.astype(compute_dtype)
+    return x.astype(compute_dtype) @ w + b
+
+
+def _dequant_matmul_kernel(x_ref, qw_ref, scale_ref, b_ref, o_ref, *,
+                           compute_dtype):
+    x = x_ref[...].astype(compute_dtype)                    # [bm, K]
+    # dequantize in-register, same elementwise order as the reference so
+    # the widened block is bit-identical — it just never leaves VMEM
+    w = qw_ref[...].astype(compute_dtype) * scale_ref[...].astype(compute_dtype)
+    acc = jax.lax.dot_general(
+        x, w, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )                                                        # [bm, bn] f32
+    # round once to compute_dtype (what the reference matmul emits), then
+    # widen for the f32 bias add — keeps the epilogue bit-close to XLA
+    o_ref[...] = acc.astype(compute_dtype).astype(jnp.float32) + b_ref[...]
+
+
+@functools.partial(jax.jit, static_argnames=("compute_dtype", "interpret"))
+def dequant_matmul(
+    x: jax.Array,        # [M, K] any float dtype
+    qw: jax.Array,       # i8[K, N]
+    scale: jax.Array,    # f32[N] per-output-channel
+    b: jax.Array,        # f32[N]
+    compute_dtype=jnp.bfloat16,
+    interpret: bool = False,
+) -> jax.Array:
+    """Fused ``x @ dequant(qw, scale) + b`` -> f32[M, N].
+
+    Grid is (M/block_m, N/128); each program owns one output tile and
+    reads the full K extent. Callers must pre-check ``matmul_supported``;
+    ``interpret=True`` runs through the Pallas interpreter (CPU-testable).
+    """
+    m, k = x.shape
+    _, n = qw.shape
+    if not matmul_supported(m, k, n):
+        raise ValueError(f"unsupported dequant_matmul shape [{m},{k}]x[{k},{n}]")
+    block_m = _pick_block_m(m)
+    block_n = 128
+
+    # scale/bias staged as [1, N] f32 so their trailing dims satisfy the
+    # TPU lane tiling (same trick as the flash-attention mask)
+    scale2 = scale.astype(jnp.float32)[None, :]
+    b2 = b.astype(jnp.float32)[None, :]
+
+    grid = (m // block_m, n // block_n)
+    kernel = functools.partial(_dequant_matmul_kernel,
+                               compute_dtype=compute_dtype)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_m, k), lambda mi, ni: (mi, 0)),
+            pl.BlockSpec((k, block_n), lambda mi, ni: (0, ni)),
+            pl.BlockSpec((1, block_n), lambda mi, ni: (0, ni)),
+            pl.BlockSpec((1, block_n), lambda mi, ni: (0, ni)),
+        ],
+        out_specs=pl.BlockSpec((block_m, block_n), lambda mi, ni: (mi, ni)),
+        out_shape=jax.ShapeDtypeStruct((m, n), jnp.float32),
+        interpret=interpret,
+    )(x, qw, scale2, b2)
+
+
+def dequant_rows_reference(q: jax.Array, scale: jax.Array) -> jax.Array:
+    """XLA oracle — the models/bert.py:_embedding_rows widen of a gathered
+    i8 row block: f32 rows = ``q * scale[:, None]``."""
+    return q.astype(jnp.float32) * scale.astype(jnp.float32)[:, None]
+
+
+def _dequant_rows_kernel(q_ref, scale_ref, o_ref):
+    o_ref[...] = q_ref[...].astype(jnp.float32) * scale_ref[...]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def dequant_rows(
+    q: jax.Array,        # i8[rows, H] — already-gathered embedding rows
+    scale: jax.Array,    # f32[rows] per-row
+    interpret: bool = False,
+) -> jax.Array:
+    """Per-row dequant widen -> f32[rows, H].
+
+    The arbitrary-index gather itself stays an XLA i8 gather (a Pallas
+    gather buys nothing at embedding widths); this kernel fuses the widen
+    x scale so only i8 rows plus a scale vector cross HBM. Single-program
+    whole-array kernel — the gather result is batch-sized, not table-sized.
+    """
+    rows, h = q.shape
+    if not rows_supported(rows, h):
+        raise ValueError(f"unsupported dequant_rows shape [{rows},{h}]")
+    scale2 = scale.astype(jnp.float32)[:, None]              # [rows, 1]
+    return pl.pallas_call(
+        _dequant_rows_kernel,
+        grid=(1,),
+        in_specs=[
+            pl.BlockSpec((rows, h), lambda i: (0, 0)),
+            pl.BlockSpec((rows, 1), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((rows, h), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((rows, h), jnp.float32),
+        interpret=interpret,
+    )(q, scale2)

@@ -209,11 +209,14 @@ class DevicePool:
                   spec=spec, params=params, model_valid=mv_dev,
                   blob_bf16=staged.get("bf16"),
                   bert_config=self.scorer.bert_config,
-                  use_pallas=self.scorer.sc.use_pallas,
-                  # quant plane: same static kernel selection on every
-                  # replica (the scorer's params are already quantized, so
-                  # replication/hot-swap carries the int8 form for free)
-                  **self.scorer.quant_static())
+                  use_pallas=self.scorer.effective_use_pallas(),
+                  # quant + kernel planes: same static kernel selection on
+                  # every replica (the scorer's params are already
+                  # quantized, so replication/hot-swap carries the int8
+                  # form for free, and a kernel-on scorer never mixes
+                  # kernel modes within a batch)
+                  **self.scorer.quant_static(),
+                  **self.scorer.kernel_static())
 
     def dispatch_packed(self, blobs: Dict[str, np.ndarray], spec, params,
                         model_valid: np.ndarray) -> PoolToken:

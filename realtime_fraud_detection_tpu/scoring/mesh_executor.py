@@ -98,6 +98,8 @@ def _mesh_score_packed_impl(models, blob_f32, blob_i32, blob_u8, spec,
                             params, model_valid, blob_bf16=None,
                             bert_config=None, use_pallas=False,
                             tree_kernel="gather", iforest_kernel="gather",
+                            dequant_kernel="off", epilogue_kernel="off",
+                            kernel_interpret=False,
                             gather_fields: Tuple[str, ...] = (),
                             mesh=None):
     models = _regather_models(models, gather_fields, mesh)
@@ -105,7 +107,9 @@ def _mesh_score_packed_impl(models, blob_f32, blob_i32, blob_u8, spec,
         models, blob_f32, blob_i32, blob_u8, spec=spec, params=params,
         model_valid=model_valid, blob_bf16=blob_bf16,
         bert_config=bert_config, use_pallas=use_pallas,
-        tree_kernel=tree_kernel, iforest_kernel=iforest_kernel)
+        tree_kernel=tree_kernel, iforest_kernel=iforest_kernel,
+        dequant_kernel=dequant_kernel, epilogue_kernel=epilogue_kernel,
+        kernel_interpret=kernel_interpret)
 
 
 def _jit_entries():
@@ -115,7 +119,8 @@ def _jit_entries():
     import jax
 
     statics = ("spec", "bert_config", "use_pallas", "tree_kernel",
-               "iforest_kernel", "gather_fields", "mesh")
+               "iforest_kernel", "dequant_kernel", "epilogue_kernel",
+               "kernel_interpret", "gather_fields", "mesh")
     plain = partial(jax.jit, static_argnames=statics)(
         _mesh_score_packed_impl)
     try:
@@ -410,13 +415,15 @@ class MeshExecutor:
                      spec=spec, params=params, model_valid=mv_dev,
                      blob_bf16=staged.get("bf16"),
                      bert_config=self.scorer.bert_config,
-                     use_pallas=self.scorer.sc.use_pallas,
+                     use_pallas=self.scorer.effective_use_pallas(),
                      gather_fields=self._gather_fields,
                      mesh=rep.mesh,
-                     # quant plane: same static kernel selection on every
-                     # mesh replica (params are already quantized, so the
-                     # sharded storage carries the int8 form for free)
-                     **self.scorer.quant_static())
+                     # quant + kernel planes: same static kernel selection
+                     # on every mesh replica (params are already quantized,
+                     # so the sharded storage carries the int8 form for
+                     # free, and no batch ever mixes kernel modes)
+                     **self.scorer.quant_static(),
+                     **self.scorer.kernel_static())
         except Exception:
             self._mark_failed(rep)
             raise
@@ -534,9 +541,10 @@ class MeshExecutor:
             spec=spec, params=params, model_valid=rep.mv_dev(mv),
             blob_bf16=staged.get("bf16"),
             bert_config=self.scorer.bert_config,
-            use_pallas=self.scorer.sc.use_pallas,
+            use_pallas=self.scorer.effective_use_pallas(),
             gather_fields=self._gather_fields, mesh=rep.mesh,
-            **self.scorer.quant_static()).as_text()
+            **self.scorer.quant_static(),
+            **self.scorer.kernel_static()).as_text()
 
     # ---------------------------------------------------------------- stats
     def _branch_fields(self) -> Dict[str, str]:

@@ -49,6 +49,10 @@ from realtime_fraud_detection_tpu.models.trees import (
     TreeEnsemble,
     tree_ensemble_predict,
 )
+from realtime_fraud_detection_tpu.ops.epilogue import (
+    epilogue_supported,
+    fused_epilogue,
+)
 
 # Registry order (reference config.py:126-199). Index into the (B, M) matrix.
 MODEL_NAMES: tuple[str, ...] = (
@@ -157,6 +161,9 @@ def _score_fused_impl(
     with_model_preds: bool = True,
     tree_kernel: str = "gather",     # quantized plane (QuantSettings):
     iforest_kernel: str = "gather",  # gather oracle | Hummingbird GEMM form
+    dequant_kernel: str = "off",     # kernel plane (KernelSettings): Pallas
+    epilogue_kernel: str = "off",    # fused dequant-matmul / score-blend
+    kernel_interpret: bool = False,  # Pallas interpreter (non-TPU hosts)
 ) -> Dict[str, jax.Array]:
     """Score one microbatch through the full 5-model ensemble.
 
@@ -179,6 +186,8 @@ def _score_fused_impl(
             bert_predict(
                 models.bert, batch.token_ids, batch.token_mask,
                 bert_config, use_pallas=use_pallas,
+                dequant_kernel=dequant_kernel,
+                kernel_interpret=kernel_interpret,
             ),
             jax.nn.sigmoid(
                 gnn_logits(
@@ -199,10 +208,17 @@ def _score_fused_impl(
     )                                                            # f32[B, M]
 
     valid = jnp.broadcast_to(model_valid[None, :], preds.shape) & batch.valid[:, None]
-    combined = combine_predictions(preds, valid, params)
-
-    out = dict(combined)
-    out["rule_score"] = rule_score(batch.txn)
+    rule = rule_score(batch.txn)
+    if (epilogue_kernel == "pallas"
+            and epilogue_supported(preds.shape[0], preds.shape[1])):
+        # fused score-and-blend (ops/epilogue.py): combine + decision/risk
+        # ladders + the finalize-derived columns (explanation contributions,
+        # rules-only ladder) run on-chip in one kernel
+        out = dict(fused_epilogue(preds, valid, rule, params,
+                                  interpret=kernel_interpret))
+    else:
+        out = dict(combine_predictions(preds, valid, params))
+    out["rule_score"] = rule
     out.update(_key_factors(batch.txn))
     if with_model_preds:
         out["model_predictions"] = preds
@@ -212,7 +228,8 @@ def _score_fused_impl(
 score_fused = partial(
     jax.jit,
     static_argnames=("bert_config", "use_pallas", "with_model_preds",
-                     "tree_kernel", "iforest_kernel"),
+                     "tree_kernel", "iforest_kernel", "dequant_kernel",
+                     "epilogue_kernel", "kernel_interpret"),
 )(_score_fused_impl)
 
 
@@ -223,6 +240,22 @@ OUT_COLUMNS: tuple[str, ...] = (
     "fraud_probability", "confidence", "decision", "risk_level",
     "rule_score", "high_amount", "unusual_hour", "high_risk_payment",
 )
+
+# With the fused epilogue on (KernelSettings.epilogue="pallas"), the packed
+# matrix grows the finalize-derived columns the host used to recompute per
+# record: per-model explanation contributions (weights x preds) and the QoS
+# rules-only decision/risk ladder over the rule score. Layout becomes
+# f32[B, 8 + M + M + 2]: OUT_COLUMNS, model predictions, then these.
+# _build_responses detects the extension by width, so the kernels-off
+# layout stays byte-identical to the legacy one.
+EXT_COLUMNS: tuple[str, ...] = ("model_contributions", "rule_decision",
+                                "rule_risk")
+
+
+def packed_width(num_models: int, epilogue: bool) -> int:
+    """Width of the packed result matrix for a given layout."""
+    base = len(OUT_COLUMNS) + num_models
+    return base + num_models + 2 if epilogue else base
 
 
 def _score_fused_packed_impl(
@@ -238,6 +271,9 @@ def _score_fused_packed_impl(
     use_pallas: bool = False,
     tree_kernel: str = "gather",
     iforest_kernel: str = "gather",
+    dequant_kernel: str = "off",
+    epilogue_kernel: str = "off",
+    kernel_interpret: bool = False,
 ) -> jax.Array:
     """Transfer-optimal fused scorer: packed blobs in, one matrix out.
 
@@ -265,15 +301,26 @@ def _score_fused_packed_impl(
         bert_config=bert_config, use_pallas=use_pallas,
         with_model_preds=True,
         tree_kernel=tree_kernel, iforest_kernel=iforest_kernel,
+        dequant_kernel=dequant_kernel, epilogue_kernel=epilogue_kernel,
+        kernel_interpret=kernel_interpret,
     )
     cols = [out[name].astype(jnp.float32) for name in OUT_COLUMNS]
-    return jnp.concatenate(
-        [jnp.stack(cols, axis=1), out["model_predictions"]], axis=1)
+    parts = [jnp.stack(cols, axis=1), out["model_predictions"]]
+    if "model_contributions" in out:
+        # fused-epilogue extension (EXT_COLUMNS): finalize's derived
+        # columns come back in the same single d2h matrix
+        parts.append(out["model_contributions"].astype(jnp.float32))
+        parts.append(jnp.stack(
+            [out["rule_decision"].astype(jnp.float32),
+             out["rule_risk"].astype(jnp.float32)], axis=1))
+    return jnp.concatenate(parts, axis=1)
 
 
 score_fused_packed = partial(
     jax.jit, static_argnames=("spec", "bert_config", "use_pallas",
-                              "tree_kernel", "iforest_kernel"),
+                              "tree_kernel", "iforest_kernel",
+                              "dequant_kernel", "epilogue_kernel",
+                              "kernel_interpret"),
 )(_score_fused_packed_impl)
 
 # Donated-input variant for the device pool's per-replica dispatch
@@ -287,7 +334,9 @@ score_fused_packed = partial(
 try:
     score_fused_packed_donated = partial(
         jax.jit, static_argnames=("spec", "bert_config", "use_pallas",
-                                  "tree_kernel", "iforest_kernel"),
+                                  "tree_kernel", "iforest_kernel",
+                                  "dequant_kernel", "epilogue_kernel",
+                                  "kernel_interpret"),
         donate_argnames=("blob_f32", "blob_i32", "blob_u8", "blob_bf16"),
     )(_score_fused_packed_impl)
 except TypeError:  # pragma: no cover - older jax

@@ -62,7 +62,11 @@ from realtime_fraud_detection_tpu.state.stores import (
     TransactionCache,
     VelocityStore,
 )
-from realtime_fraud_detection_tpu.utils.config import Config
+from realtime_fraud_detection_tpu.utils.config import (
+    Config,
+    KernelSettings,
+    VALID_KERNEL_SITES,
+)
 
 
 import dataclasses
@@ -335,6 +339,19 @@ class FraudScorer:
         # divergence-gate verdict ledger (rtfd quant-drill records its
         # oracle verdicts here; obs.metrics.sync_quant mirrors the counts)
         self._quant_gate_counts: Dict[str, int] = {"pass": 0, "fail": 0}
+        # Pallas kernel plane (ops/ + KernelSettings): per-site static
+        # kernel selection for the fused program. Interpret mode is
+        # resolved ONCE per scorer from the backend — on non-TPU hosts the
+        # kernels run through the Pallas interpreter (the parity-pinned
+        # CPU path); on TPU they lower for real. Dispatch/fallback
+        # counters are kept host-side using the SAME supports() predicates
+        # the traced code consults (obs.metrics.sync_kernels mirrors them).
+        self.kernels = getattr(self.config, "kernels", None) or KernelSettings()
+        self._kernel_interpret = jax.default_backend() != "tpu"
+        self._kernel_counts: Dict[str, Dict[str, int]] = {
+            "dispatch": {s: 0 for s in VALID_KERNEL_SITES},
+            "fallback": {s: 0 for s in VALID_KERNEL_SITES},
+        }
         self.ensemble_params = EnsembleParams.from_config(self.config, MODEL_NAMES)
         enabled = self.config.get_enabled_models()
         self.model_valid = np.asarray(
@@ -687,6 +704,86 @@ class FraudScorer:
             "gate": dict(self._quant_gate_counts),
         }
 
+    # ------------------------------------------------------------ kernel plane
+    def kernel_static(self) -> Dict[str, Any]:
+        """The kernel-plane static kwargs for the fused program — threaded
+        into every dispatch next to ``quant_static()``. All-off while the
+        plane is disabled, so the compiled program (and the packed result
+        layout) is byte-identical to the legacy one."""
+        if not self.kernels.enabled:
+            return {"dequant_kernel": "off", "epilogue_kernel": "off",
+                    "kernel_interpret": False}
+        return {"dequant_kernel": self.kernels.dequant_matmul,
+                "epilogue_kernel": self.kernels.epilogue,
+                "kernel_interpret": self._kernel_interpret}
+
+    def effective_use_pallas(self) -> bool:
+        """Attention implementation selection: with the kernel plane on,
+        KernelSettings.attention decides (the tune_tpu.py-driven flip);
+        otherwise the legacy ScorerConfig.use_pallas flag stands."""
+        if self.kernels.enabled:
+            return self.kernels.attention == "flash"
+        return bool(self.sc.use_pallas)
+
+    def _record_kernel_dispatch(self, size: int) -> None:
+        """Host-side mirror of the per-site kernel engagement for one
+        microbatch launch. A site counts as dispatched when its mode asks
+        for the Pallas kernel, and as a fallback when the shape/layout
+        guard the TRACED code consults (the shared supports() predicates)
+        routes it back to the XLA path — so ``kernel_fallback_total``
+        reports exactly what the compiled program did, without a device
+        readback."""
+        if not self.kernels.enabled:
+            return
+        from realtime_fraud_detection_tpu.models.quant import (
+            is_quantized_bert,
+        )
+        from realtime_fraud_detection_tpu.ops import (
+            epilogue_supported,
+            matmul_supported,
+            rows_supported,
+        )
+
+        modes = self.kernels.site_modes()
+        disp, fall = (self._kernel_counts["dispatch"],
+                      self._kernel_counts["fallback"])
+        h = self.bert_config.hidden_size
+        ffn = self.bert_config.intermediate_size
+        s = self.sc.text_len
+        m = size * s
+        if modes["dequant_matmul"] == "pallas":
+            disp["dequant_matmul"] += 1
+            # f32 params have no int8 site to fuse — structurally the XLA
+            # path, counted as a fallback like any other guard miss
+            ok = (is_quantized_bert(self.models.bert)
+                  and matmul_supported(m, h, h)
+                  and matmul_supported(m, h, ffn)
+                  and matmul_supported(m, ffn, h)
+                  and rows_supported(m, h) and rows_supported(s, h))
+            if not ok:
+                fall["dequant_matmul"] += 1
+        if modes["epilogue"] == "pallas":
+            disp["epilogue"] += 1
+            if not epilogue_supported(size, NUM_MODELS):
+                fall["epilogue"] += 1
+        if modes["attention"] == "flash":
+            disp["attention"] += 1
+            if s % min(128, s):
+                fall["attention"] += 1
+
+    def kernel_snapshot(self) -> Dict[str, Any]:
+        """Kernel-plane observability payload (obs.metrics.sync_kernels):
+        effective per-site modes, whether the Pallas interpreter is
+        serving (non-TPU hosts), and cumulative dispatch/fallback counts
+        per site."""
+        return {
+            "modes": self.kernels.site_modes(),
+            "interpret": bool(self.kernels.enabled
+                              and self._kernel_interpret),
+            "dispatch": dict(self._kernel_counts["dispatch"]),
+            "fallback": dict(self._kernel_counts["fallback"]),
+        }
+
     # ---------------------------------------------------------------- assembly
     def assemble(self, records: Sequence[Mapping[str, Any]],
                  now: Optional[float] = None) -> ScoreBatch:
@@ -961,6 +1058,7 @@ class FraudScorer:
 
         mv = self.effective_model_valid()
         rules_only = self._qos_rules_only
+        self._record_kernel_dispatch(size)
         token = None
         if self._pool is not None:
             # pooled mode: the whole microbatch runs on ONE replica (model
@@ -982,8 +1080,9 @@ class FraudScorer:
                 spec=spec, params=self.ensemble_params,
                 model_valid=self._model_valid_dev(mv),
                 blob_bf16=sharded["bf16"],
-                bert_config=self.bert_config, use_pallas=self.sc.use_pallas,
-                **self.quant_static(),
+                bert_config=self.bert_config,
+                use_pallas=self.effective_use_pallas(),
+                **self.quant_static(), **self.kernel_static(),
             )
         # Start the device->host copy NOW (it queues behind the compute):
         # by the time finalize() calls device_get, the transfer is already
@@ -1068,9 +1167,21 @@ class FraudScorer:
         conf = col["confidence"]
         decisions = col["decision"].astype(np.int32)
         risk = col["risk_level"].astype(np.int32)
-        preds = mat[:, len(OUT_COLUMNS):]
+        base_w = len(OUT_COLUMNS) + NUM_MODELS
+        # fused-epilogue extension (pipeline.EXT_COLUMNS, detected by
+        # width): the device already computed the explanation
+        # contributions and the rules-only ladder — finalize reads the
+        # columns instead of re-deriving them per record
+        extended = mat.shape[1] >= base_w + NUM_MODELS + 2
+        preds = mat[:, len(OUT_COLUMNS):base_w]
+        contrib_cols = mat[:, base_w:base_w + NUM_MODELS] if extended else None
         rule = col["rule_score"]
-        if rules_only:
+        if rules_only and extended:
+            probs = rule
+            conf = np.ones_like(probs)
+            decisions = mat[:, base_w + NUM_MODELS].astype(np.int32)
+            risk = mat[:, base_w + NUM_MODELS + 1].astype(np.int32)
+        elif rules_only:
             # the ladder's last rung: no learned branch survives; serve the
             # rule score with the decision/risk ladders recomputed host-side
             # (the device combine saw zero valid branches). Confidence is
@@ -1115,11 +1226,20 @@ class FraudScorer:
                     factors.append("unusual_transaction_hour")
                 if high_risk_payment[i]:
                     factors.append("high_risk_payment_method")
-                contributions = {
-                    name: float(weights[j] * preds[i, j])
-                    for j, name in enumerate(MODEL_NAMES)
-                    if model_valid[j]
-                }
+                if contrib_cols is not None:
+                    # device-computed (ops/epilogue.py), bit-equal to the
+                    # single host f32 product it replaces
+                    contributions = {
+                        name: float(contrib_cols[i, j])
+                        for j, name in enumerate(MODEL_NAMES)
+                        if model_valid[j]
+                    }
+                else:
+                    contributions = {
+                        name: float(weights[j] * preds[i, j])
+                        for j, name in enumerate(MODEL_NAMES)
+                        if model_valid[j]
+                    }
                 explanation = {
                     "model_contributions": contributions,
                     "key_factors": factors,

@@ -633,6 +633,7 @@ class ServingApp:
         # registry at scrape time (cheap gauge sets + counter deltas)
         self.metrics.sync_host_stats(self.scorer.host_stats())
         self.metrics.sync_quant(self.scorer.quant_snapshot())
+        self.metrics.sync_kernels(self.scorer.kernel_snapshot())
         self.metrics.sync_graph(self.scorer.graph_snapshot())
         self.metrics.sync_microbatch(self.batcher.close_reasons)
         if self.pool is not None:

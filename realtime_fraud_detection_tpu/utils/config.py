@@ -708,6 +708,83 @@ class QuantSettings:
         return {"bert_weights": self.bert_mode()}
 
 
+VALID_KERNEL_SITES = ("dequant_matmul", "epilogue", "attention")
+VALID_KERNEL_MODES = ("off", "pallas")
+VALID_ATTENTION_KERNELS = ("reference", "flash")
+
+
+@dataclass
+class KernelSettings:
+    """Hand-written Pallas kernel plane (ops/): per-site kernel selection
+    for the fused scoring program.
+
+    Three sites, selectable independently (the quant-plane discipline:
+    structural detection where possible, static program selection
+    otherwise, no recompile-on-swap surprises):
+
+    - ``dequant_matmul``: the int8 BERT branch's fused dequant-matmul
+      (ops/dequant_matmul.py) — the i8 -> compute-dtype widen happens in
+      VMEM inside the kernel instead of trusting XLA to fuse the
+      ``(i8 -> bf16) * scale`` weight read. Only engages where the params
+      actually carry the weight-only int8 layout (models/quant.py); f32
+      sites keep the plain matmul.
+    - ``epilogue``: the fused score-and-blend epilogue (ops/epilogue.py)
+      — branch predictions, branch-validity/QoS masks, blend weights and
+      the decision/risk ladders combine on-chip, and the packed result
+      matrix grows the per-model contribution + rules-only ladder columns
+      so ``FraudScorer.finalize`` does pure column reads instead of
+      per-record host blend math.
+    - ``attention``: flash (blockwise Pallas) vs reference attention for
+      the text encoder — the default flip is DRIVEN by the tune_tpu.py
+      sweep, never hardcoded.
+
+    Off by default: the plane is opt-in (config/JSON overlay, or the
+    bench/tune/soak ``--kernels`` switches) until the TPU relay window
+    proves the MXU bet. Kernel selection is RUNTIME config — never
+    serialized into checkpoints, never part of the arch stamp — and the
+    modes are STATIC arguments to the fused program (changing them
+    recompiles once, like a quant kernel change). On hosts without a TPU
+    the kernels run through the Pallas interpreter, pinned against the
+    XLA reference by ``rtfd kernel-drill``.
+    """
+
+    enabled: bool = False
+    dequant_matmul: str = "off"     # off | pallas
+    epilogue: str = "off"           # off | pallas
+    attention: str = "reference"    # reference | flash
+
+    def validate(self) -> None:
+        for name, mode in (("dequant_matmul", self.dequant_matmul),
+                           ("epilogue", self.epilogue)):
+            if mode not in VALID_KERNEL_MODES:
+                raise ValueError(
+                    f"kernels.{name} must be one of {VALID_KERNEL_MODES}, "
+                    f"got {mode!r}")
+        if self.attention not in VALID_ATTENTION_KERNELS:
+            raise ValueError(
+                f"kernels.attention must be one of "
+                f"{VALID_ATTENTION_KERNELS}, got {self.attention!r}")
+
+    @classmethod
+    def full(cls) -> "KernelSettings":
+        """The everything-on preset behind the CLI/relay ``--kernels``
+        switches: fused dequant-matmul + fused epilogue + flash attention
+        — exactly the configuration ``rtfd kernel-drill`` gates."""
+        return cls(enabled=True, dequant_matmul="pallas",
+                   epilogue="pallas", attention="flash")
+
+    def site_modes(self) -> Dict[str, str]:
+        """Effective per-site modes (everything off while disabled) —
+        the shape ``FraudScorer.kernel_snapshot`` and the kernel_*
+        Prometheus series report."""
+        if not self.enabled:
+            return {"dequant_matmul": "off", "epilogue": "off",
+                    "attention": "reference"}
+        return {"dequant_matmul": self.dequant_matmul,
+                "epilogue": self.epilogue,
+                "attention": self.attention}
+
+
 @dataclass
 class StateConfig:
     """Windowed state store settings (RedisService.java key TTLs)."""
@@ -819,6 +896,7 @@ class Config:
     chaos: ChaosSettings = field(default_factory=ChaosSettings)
     quant: QuantSettings = field(default_factory=QuantSettings)
     cluster: ClusterSettings = field(default_factory=ClusterSettings)
+    kernels: KernelSettings = field(default_factory=KernelSettings)
 
     def __post_init__(self) -> None:
         self._apply_env()
@@ -1000,6 +1078,7 @@ class Config:
         self.chaos.validate()
         self.quant.validate()
         self.cluster.validate()
+        self.kernels.validate()
 
 
 def _merge_dataclass(obj: Any, data: Dict[str, Any]) -> None:

@@ -88,6 +88,13 @@ def run() -> None:
     # weights host-side once per scorer build, before any timed window.
     quant = "--quant" in sys.argv
     out["quantized"] = quant
+    # --kernels: every config serves the Pallas kernel plane (fused
+    # dequant-matmul + fused score-and-blend epilogue + flash attention —
+    # the rtfd kernel-drill gated configuration), so one relay window
+    # captures kernel-on e2e rates next to the f32/--quant ones.
+    # Composes with --quant: the dequant kernel engages on the int8 form.
+    kernels_on = "--kernels" in sys.argv
+    out["kernels"] = kernels_on
     # --mesh: every config scores through a MeshExecutor (GSPMD
     # data x model over all addressable chips, BERT branch stored sharded
     # over ``model`` — the rtfd mesh-drill gated path) instead of the
@@ -140,7 +147,8 @@ def run() -> None:
     for max_batch, depth, bf16, explain in sweep:
         label = (f"b{max_batch}-d{depth}"
                  f"{'-bf16' if bf16 else ''}{'-explain' if explain else ''}"
-                 f"{'-quant' if quant else ''}{'-mesh' if mesh_on else ''}")
+                 f"{'-quant' if quant else ''}{'-mesh' if mesh_on else ''}"
+                 f"{'-kern' if kernels_on else ''}")
         log(f"config {label}: building scorer")
         cfg = Config()
         cfg.ensemble.enable_explanation = explain
@@ -150,6 +158,12 @@ def run() -> None:
             )
 
             cfg.quant = QuantSettings.full()
+        if kernels_on:
+            from realtime_fraud_detection_tpu.utils.config import (
+                KernelSettings,
+            )
+
+            cfg.kernels = KernelSettings.full()
         scorer = FraudScorer(
             config=cfg,
             scorer_config=ScorerConfig(text_len=64, transfer_bf16=bf16),
@@ -201,6 +215,10 @@ def run() -> None:
         from realtime_fraud_detection_tpu.utils.config import QuantSettings
 
         cfg.quant = QuantSettings.full()
+    if kernels_on:
+        from realtime_fraud_detection_tpu.utils.config import KernelSettings
+
+        cfg.kernels = KernelSettings.full()
     scorer = FraudScorer(config=cfg, scorer_config=ScorerConfig(text_len=64),
                          bert_config=bert_config)
     attach_mesh(scorer, 4)   # >= the hand-rolled depth-3 loop below
@@ -239,7 +257,8 @@ def run() -> None:
     best = max(out["configs"], key=lambda e: e["txn_per_s"])
     out["best"] = best
     here = os.path.dirname(os.path.abspath(__file__))
-    suffix = f"{'_quant' if quant else ''}{'_mesh' if mesh_on else ''}"
+    suffix = (f"{'_quant' if quant else ''}{'_mesh' if mesh_on else ''}"
+              f"{'_kern' if kernels_on else ''}")
     fname = ("MEASUREMENTS_smoke.json" if smoke
              else (f"MEASUREMENTS_r05_onchip{suffix}.json" if suffix
                    else "MEASUREMENTS_r05_onchip.json"))

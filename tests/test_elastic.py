@@ -690,8 +690,8 @@ class TestElasticSettingsAndScopes:
         )
 
         assert "elastic-drill" in LOCKWATCH_DRILLS
-        # eleven since ISSUE 14 added graph-drill
-        assert len(LOCKWATCH_DRILLS) == 11
+        # twelve since ISSUE 17 added kernel-drill
+        assert len(LOCKWATCH_DRILLS) == 12
 
     def test_compact_summary_under_2kb_even_when_bloated(self):
         from realtime_fraud_detection_tpu.cluster.elastic_drill import (

@@ -501,8 +501,8 @@ class TestRegistrationAndSummary:
         )
 
         assert "partition-drill" in LOCKWATCH_DRILLS
-        # eleven since ISSUE 14 added graph-drill
-        assert len(LOCKWATCH_DRILLS) == 11
+        # twelve since ISSUE 17 added kernel-drill
+        assert len(LOCKWATCH_DRILLS) == 12
 
     def test_netfaults_in_lint_scopes(self):
         from realtime_fraud_detection_tpu.analysis.lint import (

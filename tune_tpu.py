@@ -90,10 +90,21 @@ def main() -> int:
     # invocations. Calibration pulls the f32 weights host-side ONCE, here
     # at startup, before any timed section.
     quant = "--quant" in sys.argv
-    _emit(stage="start", device=str(dev), quantized=quant)
+    # --kernels: sweep the fused program with the Pallas kernel plane on
+    # (fused dequant-matmul + fused score-and-blend epilogue + flash
+    # attention — the rtfd kernel-drill gated configuration), so one
+    # relay window captures kernel-on numbers next to the f32 / --quant
+    # sweeps (ROADMAP consolidated-capture item).
+    kernels = "--kernels" in sys.argv
+    _emit(stage="start", device=str(dev), quantized=quant, kernels=kernels)
     rng = np.random.default_rng(0)
 
     # 1 ------------------------------------------------- pallas block sweep
+    # This sweep is the flash-attention DEFAULT driver: the attn_verdict
+    # line below says whether flash beats plain XLA at the production
+    # sequence length, which is what justifies KernelSettings.full()
+    # flipping attention to "flash" (ops/attention.py block defaults).
+    attn_best: dict = {}
     for seq in (64, 128, 512):
         b, h, d = 64, 12, 64
         k, v = (jnp.asarray(rng.standard_normal((b, h, seq, d)),
@@ -104,6 +115,7 @@ def main() -> int:
         ref = jax.jit(lambda q, k, v, m: attention_reference(q, k, v, m))
         base = _time_blocked(lambda i: ref(qs[i % 8], k, v, mask), 30)
         _emit(stage="attn", seq=seq, impl="xla", **base)
+        attn_best[seq] = {"xla_p50_ms": base["p50_ms"], "flash": None}
         for bq in (64, 128, 256):
             for bk in (64, 128, 256):
                 if seq % bq or seq % bk:
@@ -118,6 +130,16 @@ def main() -> int:
                     continue
                 _emit(stage="attn", seq=seq, impl="pallas", block_q=bq,
                       block_k=bk, **t)
+                fl = attn_best[seq]["flash"]
+                if fl is None or t["p50_ms"] < fl["p50_ms"]:
+                    attn_best[seq]["flash"] = {"block_q": bq, "block_k": bk,
+                                               "p50_ms": t["p50_ms"]}
+    for seq, rec in attn_best.items():
+        fl = rec["flash"]
+        _emit(stage="attn_verdict", seq=seq,
+              flash_wins=bool(fl and fl["p50_ms"] < rec["xla_p50_ms"]),
+              best_flash=fl, xla_p50_ms=rec["xla_p50_ms"],
+              drives="KernelSettings.full() attention default")
 
     # 2 ---------------------------------------------------- bucket sweep
     bert_config = BertConfig()
@@ -180,18 +202,23 @@ def main() -> int:
         return jax.device_put(x, NamedSharding(
             mesh, P("data", *([None] * (np.ndim(x) - 1)))))
 
+    # kernel-plane statics (rtfd kernel-drill gated): flash attention +
+    # fused dequant-matmul (engages on the int8 params under --quant) +
+    # fused epilogue, compiled for real on the chip (interpret=False)
+    kern = (dict(use_pallas=True, dequant_kernel="pallas",
+                 epilogue_kernel="pallas") if kernels else {})
     if mesh is None:
         models = jax.device_put(models)
         fused = jax.jit(lambda m, b, p, v: score_fused(
             m, b, p, v, bert_config=bert_config, with_model_preds=False,
-            tree_kernel=kernel, iforest_kernel=kernel))
+            tree_kernel=kernel, iforest_kernel=kernel, **kern))
     else:
         fused = jax.jit(lambda m, b, p, v: score_fused(
             m.replace(bert=jax.tree_util.tree_map(
                 lambda x: jax.lax.with_sharding_constraint(x, _rep),
                 m.bert)),
             b, p, v, bert_config=bert_config, with_model_preds=False,
-            tree_kernel=kernel, iforest_kernel=kernel))
+            tree_kernel=kernel, iforest_kernel=kernel, **kern))
     params = EnsembleParams.from_config(Config(), list(MODEL_NAMES))
     valid = jnp.ones((len(MODEL_NAMES),), bool)
     for bucket in (64, 128, 256, 512, 1024):
