@@ -274,9 +274,16 @@ def _compact_summary(result: dict) -> dict:
         } if (qz := result.get("quantization") or {})
             and not qz.get("error") else None),
         "kernel_fusion": ({
-            name: {"pallas_us": k.get("pallas_interpret_us_per_txn"),
-                   "xla_us": k.get("xla_reference_us_per_txn")}
-            for name, k in (kf.get("kernels") or {}).items()
+            **{name: {"pallas_us": k.get("pallas_interpret_us_per_txn"),
+                      "xla_us": k.get("xla_reference_us_per_txn")}
+               for name, k in (kf.get("kernels") or {}).items()},
+            **({"mega_launches": {
+                "chain": mk.get("programs_per_microbatch_chain"),
+                "mega": mk.get("programs_per_microbatch_mega"),
+                "hbm_bytes_eliminated":
+                    mk.get("intermediate_hbm_bytes_eliminated"),
+            }} if (mk := (kf.get("kernels") or {}).get("megakernel"))
+                else {}),
         } if (kf := result.get("kernel_fusion") or {})
             and not kf.get("error") else None),
         "quality": ({"auc": quality.get("auc"),
@@ -1432,8 +1439,12 @@ def _pool_scaling_stage(result: dict, models, sc, bert_config,
     # kernel plane on (fused dequant-matmul + fused epilogue + flash
     # attention, the rtfd kernel-drill gated configuration); composes
     # with --quant so one relay window captures all four corners.
+    # --mega (RTFD_BENCH_MEGA): the kernel plane's persistent-megakernel
+    # mode (ONE program per microbatch, the kernel-drill --mega gated
+    # configuration) — implies the kernel plane on.
     quantized = os.environ.get("RTFD_BENCH_QUANT") == "1"
-    kernels_on = os.environ.get("RTFD_BENCH_KERNELS") == "1"
+    mega_on = os.environ.get("RTFD_BENCH_MEGA") == "1"
+    kernels_on = os.environ.get("RTFD_BENCH_KERNELS") == "1" or mega_on
     if quantized or kernels_on:
         from realtime_fraud_detection_tpu.utils.config import (
             Config,
@@ -1445,7 +1456,8 @@ def _pool_scaling_stage(result: dict, models, sc, bert_config,
         if quantized:
             cfg.quant = QuantSettings.full()
         if kernels_on:
-            cfg.kernels = KernelSettings.full()
+            cfg.kernels = (KernelSettings.mega() if mega_on
+                           else KernelSettings.full())
         scorer = FraudScorer(cfg, models=models, scorer_config=sc,
                              bert_config=bert_config)
     else:
@@ -1492,6 +1504,7 @@ def _pool_scaling_stage(result: dict, models, sc, bert_config,
         "n_devices": len(devices),
         "quantized": quantized,
         "kernels": kernels_on,
+        "mega": mega_on,
         "single_device_txn_per_s": round(single_tp, 1),
     }
     if len(devices) == 1:
@@ -2300,6 +2313,54 @@ def _kernel_fusion_stage(result: dict, models, sc, bert_config, it,
         "xla_reference_us_per_txn": per_txn(
             lambda i: ref_att(*qkvs[i % K]), iters, ab),
     }
+
+    # persistent megakernel (ISSUE 19): the whole packed microbatch as ONE
+    # program vs the verbatim-composition XLA reference, on the quantized
+    # text branch (the form whose VMEM plan fits the persistent grid),
+    # plus the launch/HBM accounting the fusion claim is measured by —
+    # the device programs a microbatch costs collapse from the per-branch
+    # chain to 1, and the per-branch logit/stack/pack intermediates stop
+    # round-tripping HBM entirely
+    from realtime_fraud_detection_tpu.ops import (
+        fused_megakernel,
+        mega_launch_accounting,
+        mega_plan,
+        megakernel_reference,
+    )
+    from realtime_fraud_detection_tpu.scoring.pipeline import (
+        make_example_batch,
+    )
+
+    qmodels = models.replace(bert=qbert)
+    mv = (True,) * m
+    plan = mega_plan(qmodels, bert_config, b=batch, text_len=sc.text_len,
+                     seq_len=sc.seq_len, feature_dim=sc.feature_dim,
+                     has_two_hop=False)
+    acct = mega_launch_accounting(batch, m, mega_valid=mv)
+    mk: dict = {
+        "supported": bool(plan["supported"]),
+        "block": int(plan["block"]),
+        "programs_per_microbatch_chain": acct["programs_chain"],
+        "programs_per_microbatch_mega": acct["programs_mega"],
+        "intermediate_hbm_bytes_eliminated":
+            acct["intermediate_bytes_eliminated"],
+    }
+    if plan["supported"]:
+        exs = [make_example_batch(batch, config=sc,
+                                  rng=np.random.default_rng(31 + i))
+               for i in range(K)]
+        ref_mega = jax.jit(lambda b_: megakernel_reference(
+            qmodels, b_, params, mega_valid=mv, bert_config=bert_config))
+        mk.update({
+            "pallas_interpret_us_per_txn": per_txn(
+                lambda i: fused_megakernel(
+                    qmodels, exs[i % K], params, mega_valid=mv,
+                    bert_config=bert_config, interpret=True,
+                    block=plan["block"]), it(6), batch),
+            "xla_reference_us_per_txn": per_txn(
+                lambda i: ref_mega(exs[i % K]), it(6), batch),
+        })
+    kernels["megakernel"] = mk
     entry["kernels"] = kernels
     result["kernel_fusion"] = entry
     snapshot("kernel_fusion")
@@ -2483,6 +2544,10 @@ def main() -> None:
         # kernel-plane pool_scaling (the rtfd kernel-drill gated config);
         # propagates to the inner process through the inherited env
         os.environ["RTFD_BENCH_KERNELS"] = "1"
+    if "--mega" in sys.argv:
+        # persistent-megakernel pool_scaling (the rtfd kernel-drill
+        # --mega gated config); propagates through the inherited env
+        os.environ["RTFD_BENCH_MEGA"] = "1"
     orchestrate()
 
 
@@ -2493,6 +2558,8 @@ if __name__ == "__main__":
         os.environ["RTFD_BENCH_MESH"] = "1"
     if "--kernels" in sys.argv:
         os.environ["RTFD_BENCH_KERNELS"] = "1"
+    if "--mega" in sys.argv:
+        os.environ["RTFD_BENCH_MEGA"] = "1"
     if "--inner" in sys.argv:
         run_bench()
     else:

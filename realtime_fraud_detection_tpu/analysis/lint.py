@@ -122,6 +122,9 @@ D2H_MODULES = frozenset({
     "ops/attention.py",
     "ops/dequant_matmul.py",
     "ops/epilogue.py",
+    # persistent megakernel (ISSUE 19): the whole-batch program IS the
+    # dispatch — a host pull anywhere in it would serialize every launch
+    "ops/megakernel.py",
 })
 # Function-scoped d2h contract: the scorer's dispatch half must stay
 # pull-free (finalize is the designated pull point).

@@ -145,7 +145,8 @@ def cmd_run_job(args: argparse.Namespace) -> int:
         shost, sport = _addr(args.state, 6379)
         state_client = RespClient(host=shost, port=sport)
     job_config_obj = None
-    if getattr(args, "quant", False) or getattr(args, "kernels", False):
+    if (getattr(args, "quant", False) or getattr(args, "kernels", False)
+            or getattr(args, "mega", False)):
         from realtime_fraud_detection_tpu.utils.config import (
             Config,
             KernelSettings,
@@ -158,11 +159,15 @@ def cmd_run_job(args: argparse.Namespace) -> int:
             # + GEMM-form tree kernels, the configuration rtfd quant-drill
             # gates
             job_config_obj.quant = QuantSettings.full()
-        if getattr(args, "kernels", False):
+        if getattr(args, "kernels", False) or getattr(args, "mega", False):
             # Pallas kernel plane (ops/): fused dequant-matmul + fused
             # score-and-blend epilogue + flash attention, the
-            # configuration rtfd kernel-drill gates
-            job_config_obj.kernels = KernelSettings.full()
+            # configuration rtfd kernel-drill gates; --mega swaps in the
+            # persistent megakernel (one program per microbatch, the
+            # kernel-drill --mega gated configuration)
+            job_config_obj.kernels = (
+                KernelSettings.mega() if getattr(args, "mega", False)
+                else KernelSettings.full())
     scorer = FraudScorer(job_config_obj, scorer_config=ScorerConfig(),
                          state_client=state_client)
     scorer.seed_profiles(gen.users.profiles(), gen.merchants.profiles())
@@ -387,10 +392,12 @@ def cmd_serve(args: argparse.Namespace) -> int:
         from realtime_fraud_detection_tpu.utils.config import QuantSettings
 
         config.quant = QuantSettings.full()
-    if getattr(args, "kernels", False):
+    if getattr(args, "kernels", False) or getattr(args, "mega", False):
         from realtime_fraud_detection_tpu.utils.config import KernelSettings
 
-        config.kernels = KernelSettings.full()
+        config.kernels = (KernelSettings.mega()
+                          if getattr(args, "mega", False)
+                          else KernelSettings.full())
     if getattr(args, "autotune", False):
         config.tuning.enabled = True
         # clamp the tuner's deadline search space to the budget's
@@ -700,6 +707,10 @@ def cmd_bench(args: argparse.Namespace) -> int:
         # kernel-plane pool_scaling (bench.py reads the env in the inner
         # process; see _pool_scaling_stage)
         os.environ["RTFD_BENCH_KERNELS"] = "1"
+    if getattr(args, "mega", False):
+        # persistent-megakernel pool_scaling (implies the kernel plane;
+        # bench.py reads the env in the inner process)
+        os.environ["RTFD_BENCH_MEGA"] = "1"
     bench.main()
     return 0
 
@@ -993,9 +1004,13 @@ def cmd_kernel_drill(args: argparse.Namespace) -> int:
     calibration-noise floor, zero decision flips, exact masked-blend
     equality at every QoS ladder rung, per-kernel interpret-vs-reference
     parity on the served params, zero guard fallbacks, and a bit-identical
-    second run. Prints the full summary, then a compact (<2 KB) verdict
-    as the FINAL stdout line (bench.py convention). Exit 1 unless every
-    check passed."""
+    second run. ``--mega`` swaps the kernel side onto the persistent
+    megakernel (ops/megakernel.py) and adds its oracle section: fused
+    program vs verbatim reference, GEMM-tree leaves exactly equal to the
+    pointer-chase descent, per-site counters subsumed to zero, launch
+    count collapsed to 1. Prints the full summary, then a compact (<2 KB)
+    verdict as the FINAL stdout line (bench.py convention). Exit 1 unless
+    every check passed."""
     import dataclasses as _dc
 
     from realtime_fraud_detection_tpu.scoring.kernel_drill import (
@@ -1006,6 +1021,7 @@ def cmd_kernel_drill(args: argparse.Namespace) -> int:
 
     cfg = KernelDrillConfig.fast() if args.fast else KernelDrillConfig()
     cfg = _dc.replace(cfg, seed=args.seed,
+                      mega=bool(getattr(args, "mega", False)),
                       replay=not getattr(args, "no_replay", False))
     summary = run_kernel_drill(cfg)
     print(json.dumps(summary), flush=True)
@@ -1714,6 +1730,11 @@ def build_parser() -> argparse.ArgumentParser:
                          "matmul + fused score-and-blend epilogue + flash "
                          "attention (the rtfd kernel-drill gated "
                          "configuration)")
+    sp.add_argument("--mega", action="store_true",
+                    help="persistent megakernel (ops/megakernel.py): one "
+                         "Pallas program scores the whole packed "
+                         "microbatch (implies --kernels; the rtfd "
+                         "kernel-drill --mega gated configuration)")
     sp.set_defaults(fn=cmd_run_job)
 
     sp = sub.add_parser("serve", help="run the scoring HTTP service")
@@ -1762,6 +1783,11 @@ def build_parser() -> argparse.ArgumentParser:
                          "matmul + fused score-and-blend epilogue + flash "
                          "attention (the rtfd kernel-drill gated "
                          "configuration)")
+    sp.add_argument("--mega", action="store_true",
+                    help="persistent megakernel (ops/megakernel.py): one "
+                         "Pallas program scores the whole packed "
+                         "microbatch (implies --kernels; the rtfd "
+                         "kernel-drill --mega gated configuration)")
     sp.add_argument("--trace", action="store_true",
                     help="enable the per-transaction tracing plane: "
                          "GET /latency/breakdown, GET /slo, trace_* "
@@ -1961,6 +1987,10 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--fast", action="store_true",
                     help="tier-1 sizes (the CI smoke configuration)")
     sp.add_argument("--seed", type=int, default=13)
+    sp.add_argument("--mega", action="store_true",
+                    help="serve the kernel side through the persistent "
+                         "megakernel (ops/megakernel.py: one program per "
+                         "microbatch) and add its oracle section")
     sp.add_argument("--no-replay", action="store_true",
                     help="skip the bit-identical second run (bench "
                          "stage mode; the replay gate is waived)")
@@ -2125,6 +2155,11 @@ def build_parser() -> argparse.ArgumentParser:
                          "kernel plane too (fused dequant-matmul + fused "
                          "epilogue + flash attention; labels suffixed "
                          "-kern)")
+    sp.add_argument("--mega", action="store_true",
+                    help="measure the pool_scaling stage on the "
+                         "persistent megakernel too (one program per "
+                         "microbatch; implies --kernels, labels suffixed "
+                         "-mega)")
     sp.set_defaults(fn=cmd_bench)
 
     sp = sub.add_parser("health-check", help="probe a running service")

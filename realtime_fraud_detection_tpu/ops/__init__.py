@@ -11,7 +11,15 @@ from realtime_fraud_detection_tpu.ops.dequant_matmul import (  # noqa: F401
     rows_supported,
 )
 from realtime_fraud_detection_tpu.ops.epilogue import (  # noqa: F401
+    combine_matrix,
     epilogue_reference,
     epilogue_supported,
     fused_epilogue,
+)
+from realtime_fraud_detection_tpu.ops.megakernel import (  # noqa: F401
+    fused_megakernel,
+    mega_launch_accounting,
+    mega_plan,
+    mega_supported,
+    megakernel_reference,
 )

@@ -90,15 +90,17 @@ def epilogue_reference(preds: jax.Array, valid: jax.Array, rule: jax.Array,
     return out
 
 
-def _epilogue_kernel(preds_ref, vf_ref, rule_ref, w_ref, cm_ref, o_ref, *,
-                     strategy, fraud_threshold, confidence_threshold,
-                     decline, review, monitor):
-    preds = preds_ref[...]                                   # [B, M] f32
-    vf = vf_ref[...]                                         # [B, M] f32 0/1
-    rule = rule_ref[...]                                     # [B, 1] f32
-    wvec = w_ref[...]                                        # [1, M] f32
-    cm = cm_ref[...]                                         # [1, M] f32
+def combine_matrix(preds, vf, rule, wvec, cm, *,
+                   strategy, fraud_threshold, confidence_threshold,
+                   decline, review, monitor):
+    """On-chip ensemble combine -> the [B, M+6] epilogue matrix.
 
+    Shared by the standalone fused-epilogue kernel below and the
+    persistent megakernel (ops/megakernel.py), which inlines this as its
+    final stage — one definition of the blend/ladder math, two kernels.
+    Operands: preds/vf f32[B, M], rule f32[B, 1], wvec/cm f32[1, M];
+    statics are EnsembleParams' pytree_node=False fields.
+    """
     # per-model confidence + masked weights (ensemble/combine.py:94-112)
     conf = jnp.minimum(1.0, jnp.abs(preds - 0.5) * 2.0 * cm) * vf
     w = wvec * vf
@@ -147,9 +149,19 @@ def _epilogue_kernel(preds_ref, vf_ref, rule_ref, w_ref, cm_ref, o_ref, *,
     rule_decision = _rule_ladder(rule, decline, review, monitor)
     rule_risk = _risk_code_f32(rule)
 
-    o_ref[...] = jnp.concatenate(
+    return jnp.concatenate(
         [prob, confidence, decision, risk, contributions,
          rule_decision, rule_risk], axis=1)
+
+
+def _epilogue_kernel(preds_ref, vf_ref, rule_ref, w_ref, cm_ref, o_ref, *,
+                     strategy, fraud_threshold, confidence_threshold,
+                     decline, review, monitor):
+    o_ref[...] = combine_matrix(
+        preds_ref[...], vf_ref[...], rule_ref[...], w_ref[...], cm_ref[...],
+        strategy=strategy, fraud_threshold=fraud_threshold,
+        confidence_threshold=confidence_threshold, decline=decline,
+        review=review, monitor=monitor)
 
 
 @functools.partial(jax.jit, static_argnames=(

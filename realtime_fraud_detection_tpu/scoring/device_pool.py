@@ -214,9 +214,12 @@ class DevicePool:
                   # every replica (the scorer's params are already
                   # quantized, so replication/hot-swap carries the int8
                   # form for free, and a kernel-on scorer never mixes
-                  # kernel modes within a batch)
+                  # kernel modes within a batch). The dispatch-time rung
+                  # snapshot rides in model_valid so a retry relaunches the
+                  # SAME megakernel program, not the rung the ladder moved
+                  # to meanwhile.
                   **self.scorer.quant_static(),
-                  **self.scorer.kernel_static())
+                  **self.scorer.kernel_static(model_valid))
 
     def dispatch_packed(self, blobs: Dict[str, np.ndarray], spec, params,
                         model_valid: np.ndarray) -> PoolToken:

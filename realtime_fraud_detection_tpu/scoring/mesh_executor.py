@@ -100,6 +100,7 @@ def _mesh_score_packed_impl(models, blob_f32, blob_i32, blob_u8, spec,
                             tree_kernel="gather", iforest_kernel="gather",
                             dequant_kernel="off", epilogue_kernel="off",
                             kernel_interpret=False,
+                            megakernel="off", mega_valid=None,
                             gather_fields: Tuple[str, ...] = (),
                             mesh=None):
     models = _regather_models(models, gather_fields, mesh)
@@ -109,7 +110,8 @@ def _mesh_score_packed_impl(models, blob_f32, blob_i32, blob_u8, spec,
         bert_config=bert_config, use_pallas=use_pallas,
         tree_kernel=tree_kernel, iforest_kernel=iforest_kernel,
         dequant_kernel=dequant_kernel, epilogue_kernel=epilogue_kernel,
-        kernel_interpret=kernel_interpret)
+        kernel_interpret=kernel_interpret,
+        megakernel=megakernel, mega_valid=mega_valid)
 
 
 def _jit_entries():
@@ -120,7 +122,8 @@ def _jit_entries():
 
     statics = ("spec", "bert_config", "use_pallas", "tree_kernel",
                "iforest_kernel", "dequant_kernel", "epilogue_kernel",
-               "kernel_interpret", "gather_fields", "mesh")
+               "kernel_interpret", "megakernel", "mega_valid",
+               "gather_fields", "mesh")
     plain = partial(jax.jit, static_argnames=statics)(
         _mesh_score_packed_impl)
     try:
@@ -421,9 +424,11 @@ class MeshExecutor:
                      # quant + kernel planes: same static kernel selection
                      # on every mesh replica (params are already quantized,
                      # so the sharded storage carries the int8 form for
-                     # free, and no batch ever mixes kernel modes)
+                     # free, and no batch ever mixes kernel modes). The
+                     # dispatch-time rung rides in model_valid so the
+                     # megakernel program matches the mask it serves.
                      **self.scorer.quant_static(),
-                     **self.scorer.kernel_static())
+                     **self.scorer.kernel_static(mv))
         except Exception:
             self._mark_failed(rep)
             raise
@@ -544,7 +549,7 @@ class MeshExecutor:
             use_pallas=self.scorer.effective_use_pallas(),
             gather_fields=self._gather_fields, mesh=rep.mesh,
             **self.scorer.quant_static(),
-            **self.scorer.kernel_static()).as_text()
+            **self.scorer.kernel_static(mv)).as_text()
 
     # ---------------------------------------------------------------- stats
     def _branch_fields(self) -> Dict[str, str]:

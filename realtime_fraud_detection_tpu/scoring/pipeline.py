@@ -274,6 +274,8 @@ def _score_fused_packed_impl(
     dequant_kernel: str = "off",
     epilogue_kernel: str = "off",
     kernel_interpret: bool = False,
+    megakernel: str = "off",         # persistent whole-batch program
+    mega_valid: Optional[tuple] = None,  # QoS rung as static branch mask
 ) -> jax.Array:
     """Transfer-optimal fused scorer: packed blobs in, one matrix out.
 
@@ -296,6 +298,31 @@ def _score_fused_packed_impl(
     batch = jax.tree.map(
         lambda x: x.astype(jnp.float32) if x.dtype == jnp.bfloat16 else x,
         batch)
+    if megakernel == "pallas" and mega_valid is not None:
+        # persistent megakernel (ops/megakernel.py): score the whole
+        # microbatch in ONE Pallas program whose output IS the extended
+        # packed matrix — no branch intermediates in HBM. The QoS rung
+        # rides in as the static ``mega_valid`` tuple (one cached program
+        # per rung). ``mega_plan`` is the same predicate the host-side
+        # fallback counters consult, so this trace-time guard and
+        # kernel_fallback_total always agree; unsupported shapes fall
+        # through to the per-site kernel chain below.
+        from realtime_fraud_detection_tpu.ops.megakernel import (
+            fused_megakernel,
+            mega_plan,
+        )
+
+        plan = mega_plan(
+            models, bert_config, b=int(batch.features.shape[0]),
+            text_len=int(batch.token_ids.shape[1]),
+            seq_len=int(batch.history.shape[1]),
+            feature_dim=int(batch.features.shape[1]),
+            has_two_hop=batch.user_neigh2_feat is not None)
+        if plan["supported"]:
+            return fused_megakernel(
+                models, batch, params, mega_valid=mega_valid,
+                bert_config=bert_config, interpret=kernel_interpret,
+                block=plan["block"])
     out = _score_fused_impl(
         models, batch, params, model_valid,
         bert_config=bert_config, use_pallas=use_pallas,
@@ -320,7 +347,8 @@ score_fused_packed = partial(
     jax.jit, static_argnames=("spec", "bert_config", "use_pallas",
                               "tree_kernel", "iforest_kernel",
                               "dequant_kernel", "epilogue_kernel",
-                              "kernel_interpret"),
+                              "kernel_interpret", "megakernel",
+                              "mega_valid"),
 )(_score_fused_packed_impl)
 
 # Donated-input variant for the device pool's per-replica dispatch
@@ -336,7 +364,8 @@ try:
         jax.jit, static_argnames=("spec", "bert_config", "use_pallas",
                                   "tree_kernel", "iforest_kernel",
                                   "dequant_kernel", "epilogue_kernel",
-                                  "kernel_interpret"),
+                                  "kernel_interpret", "megakernel",
+                                  "mega_valid"),
         donate_argnames=("blob_f32", "blob_i32", "blob_u8", "blob_bf16"),
     )(_score_fused_packed_impl)
 except TypeError:  # pragma: no cover - older jax

@@ -352,6 +352,16 @@ class FraudScorer:
             "dispatch": {s: 0 for s in VALID_KERNEL_SITES},
             "fallback": {s: 0 for s in VALID_KERNEL_SITES},
         }
+        # memoized static-kwarg tuples (kernel_static/quant_static): the
+        # hot dispatch path does a dict lookup instead of rebuilding the
+        # dicts per microbatch. Keyed by settings VALUES (+ the QoS rung
+        # for the megakernel), so mutating the settings or stepping the
+        # ladder lands on a different entry — never a stale one.
+        self._static_cache: Dict[tuple, Dict[str, Any]] = {}
+        # programs-per-microbatch of the most recent dispatch (1 when the
+        # megakernel engages, the chain length otherwise); exported as the
+        # kernel_launches_per_batch gauge
+        self._last_launches_per_batch = 0
         self.ensemble_params = EnsembleParams.from_config(self.config, MODEL_NAMES)
         enabled = self.config.get_enabled_models()
         self.model_valid = np.asarray(
@@ -670,11 +680,21 @@ class FraudScorer:
         """The static kernel-selection kwargs for the fused program —
         threaded into every dispatch (mesh path AND the device pool's
         per-replica launches). The BERT mode needs no static flag: the
-        compute seam detects the quantized parameter layout structurally."""
-        if not self.quant.enabled:
-            return {"tree_kernel": "gather", "iforest_kernel": "gather"}
-        return {"tree_kernel": self.quant.tree_kernel,
-                "iforest_kernel": self.quant.iforest_kernel}
+        compute seam detects the quantized parameter layout structurally.
+        Memoized by settings values — callers splat the returned dict and
+        must not mutate it."""
+        q = self.quant
+        key = ("quant", q.enabled, q.tree_kernel, q.iforest_kernel)
+        cached = self._static_cache.get(key)
+        if cached is None:
+            if not q.enabled:
+                cached = {"tree_kernel": "gather",
+                          "iforest_kernel": "gather"}
+            else:
+                cached = {"tree_kernel": q.tree_kernel,
+                          "iforest_kernel": q.iforest_kernel}
+            self._static_cache[key] = cached
+        return cached
 
     def record_quant_gate(self, passed: bool) -> None:
         """Record a divergence-oracle verdict (rtfd quant-drill / any
@@ -705,17 +725,46 @@ class FraudScorer:
         }
 
     # ------------------------------------------------------------ kernel plane
-    def kernel_static(self) -> Dict[str, Any]:
+    def kernel_static(self, model_valid=None) -> Dict[str, Any]:
         """The kernel-plane static kwargs for the fused program — threaded
         into every dispatch next to ``quant_static()``. All-off while the
         plane is disabled, so the compiled program (and the packed result
-        layout) is byte-identical to the legacy one."""
-        if not self.kernels.enabled:
-            return {"dequant_kernel": "off", "epilogue_kernel": "off",
-                    "kernel_interpret": False}
-        return {"dequant_kernel": self.kernels.dequant_matmul,
-                "epilogue_kernel": self.kernels.epilogue,
-                "kernel_interpret": self._kernel_interpret}
+        layout) is byte-identical to the legacy one.
+
+        With the megakernel on, ``mega_valid`` carries the QoS rung as a
+        compile-time branch-validity tuple (``model_valid`` when given —
+        the pool/mesh retry paths pass their dispatch-time snapshot — else
+        the current effective mask). Each rung is its own jit cache entry:
+        the per-rung program cache. With the megakernel off the key stays
+        None, so stepping the ladder never churns the jit cache (the
+        runtime-mask zero-recompile discipline is untouched). Memoized by
+        settings values + rung — callers splat, never mutate."""
+        k = self.kernels
+        if not k.enabled:
+            key = ("kernel", False)
+            cached = self._static_cache.get(key)
+            if cached is None:
+                cached = {"dequant_kernel": "off", "epilogue_kernel": "off",
+                          "kernel_interpret": False,
+                          "megakernel": "off", "mega_valid": None}
+                self._static_cache[key] = cached
+            return cached
+        mega_valid = None
+        if k.megakernel == "pallas":
+            mv = (self.effective_model_valid() if model_valid is None
+                  else np.asarray(model_valid))
+            mega_valid = tuple(bool(v) for v in mv)
+        key = ("kernel", True, k.dequant_matmul, k.epilogue, k.attention,
+               k.megakernel, self._kernel_interpret, mega_valid)
+        cached = self._static_cache.get(key)
+        if cached is None:
+            cached = {"dequant_kernel": k.dequant_matmul,
+                      "epilogue_kernel": k.epilogue,
+                      "kernel_interpret": self._kernel_interpret,
+                      "megakernel": k.megakernel,
+                      "mega_valid": mega_valid}
+            self._static_cache[key] = cached
+        return cached
 
     def effective_use_pallas(self) -> bool:
         """Attention implementation selection: with the kernel plane on,
@@ -741,12 +790,30 @@ class FraudScorer:
         from realtime_fraud_detection_tpu.ops import (
             epilogue_supported,
             matmul_supported,
+            mega_launch_accounting,
             rows_supported,
         )
 
         modes = self.kernels.site_modes()
         disp, fall = (self._kernel_counts["dispatch"],
                       self._kernel_counts["fallback"])
+        if modes.get("megakernel") == "pallas":
+            # the persistent whole-batch program (ops/megakernel.py). When
+            # its shared shape plan admits the dispatch, ONE program runs
+            # and the per-site kernels below never launch — so their
+            # counters stay untouched (the megakernel subsumes them, it
+            # does not fall back from them). A declined plan counts as a
+            # megakernel fallback AND the per-site chain is accounted as
+            # usual, because that is exactly what the traced guard runs.
+            disp["megakernel"] += 1
+            if self._mega_plan(size)["supported"]:
+                self._last_launches_per_batch = 1
+                return
+            fall["megakernel"] += 1
+        self._last_launches_per_batch = mega_launch_accounting(
+            size, NUM_MODELS,
+            mega_valid=tuple(bool(v) for v in self.effective_model_valid()),
+        )["launches_per_batch_chain"]
         h = self.bert_config.hidden_size
         ffn = self.bert_config.intermediate_size
         s = self.sc.text_len
@@ -771,17 +838,33 @@ class FraudScorer:
             if s % min(128, s):
                 fall["attention"] += 1
 
+    def _mega_plan(self, size: int) -> Dict[str, Any]:
+        """Host mirror of the trace-time megakernel shape plan for a
+        ``size``-row microbatch — the SAME ``mega_plan`` the traced
+        dispatch consults, so ``kernel_fallback_total{site="megakernel"}``
+        equals the compiled program's actual fallback behaviour."""
+        from realtime_fraud_detection_tpu.ops import mega_plan
+
+        return mega_plan(
+            self.models, self.bert_config, b=size,
+            text_len=self.sc.text_len, seq_len=self.sc.seq_len,
+            feature_dim=self.sc.feature_dim,
+            has_two_hop=self._sampler is not None,
+        )
+
     def kernel_snapshot(self) -> Dict[str, Any]:
         """Kernel-plane observability payload (obs.metrics.sync_kernels):
         effective per-site modes, whether the Pallas interpreter is
-        serving (non-TPU hosts), and cumulative dispatch/fallback counts
-        per site."""
+        serving (non-TPU hosts), cumulative dispatch/fallback counts per
+        site, and the launch count of the most recent microbatch (1 when
+        the megakernel served it; the per-site chain length otherwise)."""
         return {
             "modes": self.kernels.site_modes(),
             "interpret": bool(self.kernels.enabled
                               and self._kernel_interpret),
             "dispatch": dict(self._kernel_counts["dispatch"]),
             "fallback": dict(self._kernel_counts["fallback"]),
+            "launches_per_batch": self._last_launches_per_batch,
         }
 
     # ---------------------------------------------------------------- assembly
@@ -1082,7 +1165,7 @@ class FraudScorer:
                 blob_bf16=sharded["bf16"],
                 bert_config=self.bert_config,
                 use_pallas=self.effective_use_pallas(),
-                **self.quant_static(), **self.kernel_static(),
+                **self.quant_static(), **self.kernel_static(mv),
             )
         # Start the device->host copy NOW (it queues behind the compute):
         # by the time finalize() calls device_get, the transfer is already

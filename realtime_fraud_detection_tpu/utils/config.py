@@ -708,7 +708,8 @@ class QuantSettings:
         return {"bert_weights": self.bert_mode()}
 
 
-VALID_KERNEL_SITES = ("dequant_matmul", "epilogue", "attention")
+VALID_KERNEL_SITES = ("dequant_matmul", "epilogue", "attention",
+                      "megakernel")
 VALID_KERNEL_MODES = ("off", "pallas")
 VALID_ATTENTION_KERNELS = ("reference", "flash")
 
@@ -752,10 +753,17 @@ class KernelSettings:
     dequant_matmul: str = "off"     # off | pallas
     epilogue: str = "off"           # off | pallas
     attention: str = "reference"    # reference | flash
+    # the persistent whole-microbatch program (ops/megakernel.py). When it
+    # engages it SUBSUMES the three per-site kernels above: one Pallas
+    # program scores the batch end-to-end, and the per-site selections
+    # only matter on shapes the megakernel declines (mega_supported),
+    # which fall back to the per-site chain with honest fallback counts.
+    megakernel: str = "off"         # off | pallas
 
     def validate(self) -> None:
         for name, mode in (("dequant_matmul", self.dequant_matmul),
-                           ("epilogue", self.epilogue)):
+                           ("epilogue", self.epilogue),
+                           ("megakernel", self.megakernel)):
             if mode not in VALID_KERNEL_MODES:
                 raise ValueError(
                     f"kernels.{name} must be one of {VALID_KERNEL_MODES}, "
@@ -773,16 +781,27 @@ class KernelSettings:
         return cls(enabled=True, dequant_matmul="pallas",
                    epilogue="pallas", attention="flash")
 
+    @classmethod
+    def mega(cls) -> "KernelSettings":
+        """The ``--kernels --mega`` preset: the persistent megakernel on
+        top of the full per-site plane, which remains the fallback path
+        for shapes ``mega_supported`` declines (bucket 1, two-hop graph
+        batches, VMEM-oversized param sets)."""
+        return cls(enabled=True, dequant_matmul="pallas",
+                   epilogue="pallas", attention="flash",
+                   megakernel="pallas")
+
     def site_modes(self) -> Dict[str, str]:
         """Effective per-site modes (everything off while disabled) —
         the shape ``FraudScorer.kernel_snapshot`` and the kernel_*
         Prometheus series report."""
         if not self.enabled:
             return {"dequant_matmul": "off", "epilogue": "off",
-                    "attention": "reference"}
+                    "attention": "reference", "megakernel": "off"}
         return {"dequant_matmul": self.dequant_matmul,
                 "epilogue": self.epilogue,
-                "attention": self.attention}
+                "attention": self.attention,
+                "megakernel": self.megakernel}
 
 
 @dataclass

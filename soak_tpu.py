@@ -93,8 +93,14 @@ def run() -> None:
     # the rtfd kernel-drill gated configuration), so one relay window
     # captures kernel-on e2e rates next to the f32/--quant ones.
     # Composes with --quant: the dequant kernel engages on the int8 form.
-    kernels_on = "--kernels" in sys.argv
+    # --mega: the kernel plane serves the persistent megakernel (one
+    # Pallas program scoring the whole packed microbatch — the rtfd
+    # kernel-drill --mega gated configuration). Implies --kernels;
+    # labels gain a -mega suffix.
+    mega_on = "--mega" in sys.argv
+    kernels_on = "--kernels" in sys.argv or mega_on
     out["kernels"] = kernels_on
+    out["mega"] = mega_on
     # --mesh: every config scores through a MeshExecutor (GSPMD
     # data x model over all addressable chips, BERT branch stored sharded
     # over ``model`` — the rtfd mesh-drill gated path) instead of the
@@ -148,7 +154,8 @@ def run() -> None:
         label = (f"b{max_batch}-d{depth}"
                  f"{'-bf16' if bf16 else ''}{'-explain' if explain else ''}"
                  f"{'-quant' if quant else ''}{'-mesh' if mesh_on else ''}"
-                 f"{'-kern' if kernels_on else ''}")
+                 f"{'-kern' if kernels_on else ''}"
+                 f"{'-mega' if mega_on else ''}")
         log(f"config {label}: building scorer")
         cfg = Config()
         cfg.ensemble.enable_explanation = explain
@@ -163,7 +170,8 @@ def run() -> None:
                 KernelSettings,
             )
 
-            cfg.kernels = KernelSettings.full()
+            cfg.kernels = (KernelSettings.mega() if mega_on
+                           else KernelSettings.full())
         scorer = FraudScorer(
             config=cfg,
             scorer_config=ScorerConfig(text_len=64, transfer_bf16=bf16),
@@ -218,7 +226,8 @@ def run() -> None:
     if kernels_on:
         from realtime_fraud_detection_tpu.utils.config import KernelSettings
 
-        cfg.kernels = KernelSettings.full()
+        cfg.kernels = (KernelSettings.mega() if mega_on
+                       else KernelSettings.full())
     scorer = FraudScorer(config=cfg, scorer_config=ScorerConfig(text_len=64),
                          bert_config=bert_config)
     attach_mesh(scorer, 4)   # >= the hand-rolled depth-3 loop below

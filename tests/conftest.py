@@ -17,11 +17,24 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# Persistent XLA compilation cache, shared between the pytest process and
+# every drill-CLI subprocess the smokes spawn (they recompile the same
+# scorer programs from scratch otherwise — the cache is content-addressed
+# over HLO + compile options, so code changes miss safely). Exported via
+# env so subprocesses inherit; min-compile-time 0 because the suite is
+# dominated by many sub-second compiles, not a few large ones.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/rtfd_xla_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+
 import jax
 
 # The image's site config pins jax_platforms to the TPU tunnel ("axon,cpu")
 # regardless of env; override via jax.config before any backend is touched.
 jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_compilation_cache_dir",
+                  os.environ["JAX_COMPILATION_CACHE_DIR"])
+jax.config.update("jax_persistent_cache_min_compile_time_secs", float(
+    os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"]))
 
 import numpy as np
 import pytest

@@ -257,10 +257,14 @@ class TestKernelSettings:
                            attention="flash")       # enabled=False gates all
         assert s.site_modes() == {"dequant_matmul": "off",
                                   "epilogue": "off",
-                                  "attention": "reference"}
+                                  "attention": "reference",
+                                  "megakernel": "off"}
         assert KernelSettings.full().site_modes() == {
             "dequant_matmul": "pallas", "epilogue": "pallas",
-            "attention": "flash"}
+            "attention": "flash", "megakernel": "off"}
+        assert KernelSettings.mega().site_modes() == {
+            "dequant_matmul": "pallas", "epilogue": "pallas",
+            "attention": "flash", "megakernel": "pallas"}
 
     def test_config_overlay_round_trip(self, tmp_path):
         p = tmp_path / "k.json"
@@ -277,10 +281,13 @@ class TestScorerKernelPlane:
         _, s = _scorer(kernels=False, quant=False)
         assert s.kernel_static() == {"dequant_kernel": "off",
                                      "epilogue_kernel": "off",
-                                     "kernel_interpret": False}
+                                     "kernel_interpret": False,
+                                     "megakernel": "off",
+                                     "mega_valid": None}
         assert s.effective_use_pallas() == bool(s.sc.use_pallas)
         assert s.kernel_snapshot()["dispatch"] == {
-            "dequant_matmul": 0, "epilogue": 0, "attention": 0}
+            "dequant_matmul": 0, "epilogue": 0, "attention": 0,
+            "megakernel": 0}
 
     def test_kernel_statics_on(self):
         _, s = _scorer()
@@ -308,7 +315,10 @@ class TestScorerKernelPlane:
         s.score_batch(gen.generate_batch(BATCH), now=1000.0)
         snap = s.kernel_snapshot()
         assert snap["interpret"] is True
-        assert all(snap["dispatch"][site] == 2 for site in snap["dispatch"])
+        # full() leaves the megakernel site off — the per-site chain runs
+        assert all(snap["dispatch"][site] == 2 for site in snap["dispatch"]
+                   if site != "megakernel")
+        assert snap["dispatch"]["megakernel"] == 0
         assert all(v == 0 for v in snap["fallback"].values())
 
     def test_f32_params_count_dequant_fallback(self):

@@ -1,0 +1,460 @@
+"""Persistent megakernel (ISSUE 19): one Pallas program scores the whole
+packed microbatch — plan predicates (VMEM budget, block divisibility,
+min-batch, two-hop exclusion), interpret-mode parity of the fused program
+against the verbatim-composition reference on randomized AND
+trained/quantized params in f32 and bf16-staged inputs, per-rung static
+program cache with zero-retrace memoized statics, the scorer cascade's
+honest dispatch/fallback accounting, checkpoint hygiene (megakernel
+selection is runtime config, never serialized), device-pool/mesh
+composition with a mid-stream hot swap, the kernel_mega_* Prometheus
+mirror, and the `rtfd kernel-drill --fast --mega` tier-1 smoke."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from realtime_fraud_detection_tpu.core.mesh import build_mesh
+from realtime_fraud_detection_tpu.ensemble.combine import EnsembleParams
+from realtime_fraud_detection_tpu.models.bert import TINY_CONFIG
+from realtime_fraud_detection_tpu.models.quant import (
+    is_quantized_bert,
+    quantize_bert_params,
+)
+from realtime_fraud_detection_tpu.ops import (
+    fused_megakernel,
+    mega_launch_accounting,
+    mega_plan,
+    mega_supported,
+    megakernel_reference,
+)
+from realtime_fraud_detection_tpu.ops.megakernel import (
+    MEGA_MIN_BATCH,
+    mega_block,
+)
+from realtime_fraud_detection_tpu.scoring import (
+    MODEL_NAMES,
+    DevicePool,
+    FraudScorer,
+    MeshExecutor,
+    ScorerConfig,
+)
+from realtime_fraud_detection_tpu.scoring.pipeline import (
+    OUT_COLUMNS,
+    init_scoring_models,
+    make_example_batch,
+    packed_width,
+)
+from realtime_fraud_detection_tpu.sim.simulator import TransactionGenerator
+from realtime_fraud_detection_tpu.utils.config import (
+    Config,
+    KernelSettings,
+    QuantSettings,
+)
+
+BATCH = 16
+DEC, RISK = OUT_COLUMNS.index("decision"), OUT_COLUMNS.index("risk_level")
+
+
+def _mega_config(mega=True, quant=True) -> Config:
+    return Config(
+        quant=QuantSettings.full() if quant else QuantSettings(),
+        kernels=(KernelSettings.mega() if mega
+                 else KernelSettings() if mega is None
+                 else KernelSettings.full()))
+
+
+def _scorer(mega=True, quant=True, seed=0, gen_seed=7, one_device=False):
+    gen = TransactionGenerator(num_users=150, num_merchants=40,
+                               seed=gen_seed)
+    mesh = build_mesh(devices=jax.devices()[:1]) if one_device else None
+    s = FraudScorer(_mega_config(mega, quant),
+                    scorer_config=ScorerConfig(), mesh=mesh, seed=seed)
+    s.seed_profiles(gen.users.profiles(), gen.merchants.profiles())
+    return gen, s
+
+
+def _rows(results):
+    return [(r["transaction_id"], r["fraud_probability"], r["confidence"],
+             r["decision"], r["risk_level"]) for r in results]
+
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def models_f32():
+    """Randomized models for direct kernel-level parity (no scorer in the
+    loop) — module-scoped: immutable pytrees, built once."""
+    return init_scoring_models(jax.random.PRNGKey(3))
+
+
+@pytest.fixture(scope="module")
+def models_q(models_f32):
+    return models_f32.replace(
+        bert=quantize_bert_params(jax.device_get(models_f32.bert)))
+
+
+@pytest.fixture(scope="module")
+def blend_params():
+    return EnsembleParams.from_config(Config(), MODEL_NAMES)
+
+
+def _batch(b, rng_seed=11):
+    return make_example_batch(b, rng=np.random.default_rng(rng_seed))
+
+
+def _assert_parity(models, batch, params, mv, *, block=None, tol=1e-6):
+    ref = np.asarray(megakernel_reference(
+        models, batch, params, mega_valid=mv, bert_config=TINY_CONFIG))
+    got = np.asarray(fused_megakernel(
+        models, batch, params, mega_valid=mv, bert_config=TINY_CONFIG,
+        interpret=True, block=block))
+    assert got.shape == ref.shape == (
+        batch.batch_size, packed_width(len(MODEL_NAMES), epilogue=True))
+    assert float(np.abs(got[:, 0] - ref[:, 0]).max()) <= tol
+    # the QoS ladder columns are exact small integers — any drift is a flip
+    np.testing.assert_array_equal(got[:, DEC], ref[:, DEC])
+    np.testing.assert_array_equal(got[:, RISK], ref[:, RISK])
+    return ref, got
+
+
+# ------------------------------------------------------- shape plan honesty
+class TestMegaPlan:
+    def test_min_batch_and_divisibility(self):
+        assert mega_block(MEGA_MIN_BATCH, 1 << 20, 1 << 10) == 8
+        assert not mega_supported(1, 1 << 20, 1 << 10)
+        assert not mega_supported(MEGA_MIN_BATCH - 1, 1 << 20, 1 << 10)
+        # 12 is >= MEGA_MIN_BATCH but divisible by no block candidate
+        assert mega_block(12, 1 << 20, 1 << 10) == 0
+        assert not mega_supported(12, 1 << 20, 1 << 10)
+
+    def test_two_hop_graph_excluded(self):
+        assert mega_supported(32, 1 << 20, 1 << 10)
+        assert not mega_supported(32, 1 << 20, 1 << 10, has_two_hop=True)
+
+    def test_vmem_budget_declines_oversized_params(self, models_f32):
+        models, sc = models_f32, ScorerConfig()
+        # f32 TINY word embeddings alone (~15.6 MB) exceed the VMEM budget
+        plan = mega_plan(models, TINY_CONFIG, b=32, text_len=sc.text_len,
+                         seq_len=sc.seq_len, feature_dim=sc.feature_dim,
+                         has_two_hop=False)
+        assert not plan["supported"]
+        # full DistilBERT-base dims stay unsupported even quantized — the
+        # plan must say so honestly (tune_tpu emits supported=False)
+        assert not mega_supported(
+            32, 90 * (1 << 20), plan["act_row_bytes"])
+
+    def test_quantized_tiny_supported_with_block(self, models_q):
+        models, sc = models_q, ScorerConfig()
+        plan = mega_plan(models, TINY_CONFIG, b=32, text_len=sc.text_len,
+                         seq_len=sc.seq_len, feature_dim=sc.feature_dim,
+                         has_two_hop=False)
+        assert plan["supported"] and 32 % plan["block"] == 0
+
+    def test_launch_accounting_collapse(self):
+        mv = (True,) * len(MODEL_NAMES)
+        acct = mega_launch_accounting(128, len(MODEL_NAMES), mega_valid=mv)
+        assert acct["programs_mega"] == 1
+        assert acct["launches_per_batch_mega"] == 1
+        assert acct["programs_chain"] == len(MODEL_NAMES) + 2
+        assert acct["launches_per_batch_chain"] > 1
+        assert acct["intermediate_bytes_eliminated"] > 0
+
+
+# ------------------------------------------------- interpret-mode parity
+class TestMegakernelParity:
+    def test_randomized_params_parity_f32(self, models_f32, blend_params):
+        # f32 TINY exceeds the VMEM plan, so the block rides explicitly —
+        # parity of the program itself is dtype-independent
+        mv = (True,) * len(MODEL_NAMES)
+        _assert_parity(models_f32, _batch(BATCH), blend_params, mv, block=8)
+
+    def test_trained_quantized_params_parity(self, models_q, blend_params):
+        sc = ScorerConfig()
+        plan = mega_plan(models_q, TINY_CONFIG, b=32, text_len=sc.text_len,
+                         seq_len=sc.seq_len, feature_dim=sc.feature_dim,
+                         has_two_hop=False)
+        assert plan["supported"]
+        _assert_parity(models_q, _batch(32), blend_params,
+                       (True,) * len(MODEL_NAMES), block=plan["block"])
+
+    def test_bf16_staged_batch_parity(self, models_q, blend_params):
+        # the bf16 wire format widens back to f32 before the kernel; the
+        # fused program and the verbatim reference must agree on the SAME
+        # rounded inputs — bit-level ladder agreement, not "close enough"
+        staged = jax.tree.map(
+            lambda x: (jnp.asarray(x, jnp.bfloat16).astype(jnp.float32)
+                       if hasattr(x, "dtype") and x.dtype == jnp.float32
+                       else x), _batch(BATCH))
+        _assert_parity(models_q, staged, blend_params,
+                       (True,) * len(MODEL_NAMES), block=8)
+
+    def test_qos_rung_statics_parity(self, models_q, blend_params):
+        batch = _batch(BATCH)
+        for mv in ((True, False, False, True, True),
+                   (False,) * len(MODEL_NAMES)):
+            ref, got = _assert_parity(models_q, batch, blend_params, mv,
+                                      block=8)
+            # rules-only rung: probability IS the rule score, bit-exact
+            if not any(mv):
+                np.testing.assert_array_equal(got[:, 0], ref[:, 0])
+
+
+# ------------------------------------------------------- scorer cascade
+class TestScorerMegaPlane:
+    def test_mega_site_modes_and_never_serialized_default(self):
+        assert KernelSettings.mega().site_modes()["megakernel"] == "pallas"
+        assert KernelSettings.full().site_modes()["megakernel"] == "off"
+
+    def test_end_to_end_matches_kernels_off(self):
+        gen_a, off = _scorer(mega=None)
+        gen_b, mega = _scorer(mega=True)
+        ra = off.score_batch(gen_a.generate_batch(BATCH), now=1000.0)
+        rb = mega.score_batch(gen_b.generate_batch(BATCH), now=1000.0)
+        assert [r["decision"] for r in ra] == [r["decision"] for r in rb]
+        assert [r["risk_level"] for r in ra] == [r["risk_level"] for r in rb]
+        pa = np.asarray([r["fraud_probability"] for r in ra])
+        pb = np.asarray([r["fraud_probability"] for r in rb])
+        assert np.max(np.abs(pa - pb)) < 1e-3
+        snap = mega.kernel_snapshot()
+        assert snap["dispatch"]["megakernel"] == 1
+        assert all(v == 0 for site, v in snap["dispatch"].items()
+                   if site != "megakernel")
+        assert all(v == 0 for v in snap["fallback"].values())
+        assert snap["launches_per_batch"] == 1
+
+    def test_unsupported_bucket_honest_fallback(self):
+        # single-device mesh so a 1-record batch stays in bucket 1 (the
+        # harness's 8-virtual-device mesh would round it up to 8)
+        gen, s = _scorer(mega=True, one_device=True)
+        s.score_batch(gen.generate_batch(1), now=1000.0)  # bucket 1 < min
+        snap = s.kernel_snapshot()
+        assert snap["dispatch"]["megakernel"] == 1
+        assert snap["fallback"]["megakernel"] == 1
+        # the per-site chain took over — its counting proceeds honestly
+        assert snap["dispatch"]["dequant_matmul"] == 1
+        acct = mega_launch_accounting(
+            1, len(MODEL_NAMES),
+            mega_valid=tuple(bool(v) for v in s.effective_model_valid()))
+        assert snap["launches_per_batch"] == \
+            acct["launches_per_batch_chain"] > 1
+
+    def test_zero_retrace_memoized_statics(self):
+        from realtime_fraud_detection_tpu.scoring.pipeline import (
+            score_fused_packed,
+        )
+
+        gen, s = _scorer(mega=True)
+        assert s.kernel_static() is s.kernel_static()
+        assert s.quant_static() is s.quant_static()
+        s.score_batch(gen.generate_batch(BATCH), now=1000.0)
+        compiled = score_fused_packed._cache_size()
+        for _ in range(3):
+            s.score_batch(gen.generate_batch(BATCH), now=1000.0)
+        assert score_fused_packed._cache_size() == compiled
+        # per-rung program cache: each rung is its own static key...
+        full = (True,) * len(MODEL_NAMES)
+        rung = (True, False, True, True, True)
+        assert s.kernel_static(full) is s.kernel_static(full)
+        assert s.kernel_static(rung) is not s.kernel_static(full)
+        assert s.kernel_static(rung)["mega_valid"] == rung
+
+    def test_ladder_never_churns_cache_when_mega_off(self):
+        # with the megakernel off, mega_valid stays None for every rung —
+        # stepping the QoS ladder reuses ONE static dict (and program)
+        _, s = _scorer(mega=False)
+        full = (True,) * len(MODEL_NAMES)
+        rung = (True, False, True, True, True)
+        assert s.kernel_static(full) is s.kernel_static(rung)
+        assert s.kernel_static(full)["mega_valid"] is None
+
+
+# ------------------------------------------------------- checkpoint hygiene
+class TestCheckpointMegaHygiene:
+    def test_one_checkpoint_serves_mega_on_and_off(self, tmp_path):
+        """Megakernel selection is runtime config: one checkpoint restores
+        into a mega-on scorer AND a mega-off scorer, each keeps its own
+        (unserialized) kernel selection, and both serve the same
+        decisions."""
+        from realtime_fraud_detection_tpu.checkpoint import CheckpointManager
+
+        _, src = _scorer(mega=None, seed=0)
+        mgr = CheckpointManager(tmp_path / "ck")
+        mgr.save(3, params=src.models)
+        manifest = mgr.manifest(3)
+        assert not any("kernel" in k or "mega" in k for k in manifest)
+
+        gen_off, off = _scorer(mega=None, seed=9)
+        gen_on, on = _scorer(mega=True, seed=9)
+        assert mgr.restore_into_scorer(off).step == 3
+        assert mgr.restore_into_scorer(on).step == 3
+        assert off.kernel_static()["megakernel"] == "off"
+        assert on.kernel_static()["megakernel"] == "pallas"
+        ra = off.score_batch(gen_off.generate_batch(BATCH), now=1000.0)
+        rb = on.score_batch(gen_on.generate_batch(BATCH), now=1000.0)
+        assert [r["decision"] for r in ra] == [r["decision"] for r in rb]
+        pa = np.asarray([r["fraud_probability"] for r in ra])
+        pb = np.asarray([r["fraud_probability"] for r in rb])
+        assert np.max(np.abs(pa - pb)) < 1e-3
+        assert on.kernel_snapshot()["dispatch"]["megakernel"] == 1
+
+
+# ------------------------------------------------- pool / mesh composition
+class TestPoolMeshMegaComposition:
+    @staticmethod
+    def _pipelined(scorer, batches, swap_to=None):
+        """Depth-2 pipelined drive with an optional mid-stream hot swap
+        after the first finalize — the SAME interleaving on both sides so
+        state evolution (and the swap point) matches step for step."""
+        from collections import deque
+
+        pend, got = deque(), []
+        for i, b in enumerate(batches):
+            pend.append(scorer.dispatch(b, now=1000.0))
+            if len(pend) >= 2:
+                got.append(_rows(scorer.finalize(pend.popleft(),
+                                                 now=1000.0)))
+                if i == 1 and swap_to is not None:
+                    scorer.set_models(swap_to)
+                    assert is_quantized_bert(scorer.models.bert)
+        while pend:
+            got.append(_rows(scorer.finalize(pend.popleft(), now=1000.0)))
+        return got
+
+    def _fresh_models(self, scorer):
+        return init_scoring_models(jax.random.PRNGKey(42),
+                                   bert_config=scorer.bert_config,
+                                   feature_dim=scorer.sc.feature_dim,
+                                   node_dim=scorer.sc.node_dim)
+
+    def test_pool_mega_bit_identical_with_hot_swap(self):
+        sides = []
+        for use_pool in (False, True):
+            gen, s = _scorer(mega=True)
+            if use_pool:
+                DevicePool(s, inflight_depth=2)
+            batches = [gen.generate_batch(BATCH) for _ in range(4)]
+            sides.append(self._pipelined(s, batches,
+                                         swap_to=self._fresh_models(s)))
+            snap = s.kernel_snapshot()
+            assert snap["dispatch"]["megakernel"] == 4
+            assert all(v == 0 for v in snap["fallback"].values())
+            assert snap["launches_per_batch"] == 1
+        assert sides[0] == sides[1]
+
+    def test_mesh_mega_pipelined_depth2_with_hot_swap(self):
+        gen_a, ref = _scorer(mega=True, one_device=True)
+        want = self._pipelined(
+            ref, [gen_a.generate_batch(BATCH) for _ in range(4)],
+            swap_to=self._fresh_models(ref))
+
+        gen_b, meshed = _scorer(mega=True, one_device=True)
+        MeshExecutor(meshed, model_axis=2, inflight_depth=2,
+                     shard_branches=("bert_text",))
+        got = self._pipelined(
+            meshed, [gen_b.generate_batch(BATCH) for _ in range(4)],
+            swap_to=self._fresh_models(meshed))
+        assert got == want
+        snap = meshed.kernel_snapshot()
+        assert snap["dispatch"]["megakernel"] == 4
+        assert all(v == 0 for site, v in snap["dispatch"].items()
+                   if site != "megakernel")
+        assert all(v == 0 for v in snap["fallback"].values())
+
+
+# ----------------------------------------------------------------- metrics
+class TestMegaMetrics:
+    def test_sync_kernels_mega_counters_and_gauge(self):
+        from realtime_fraud_detection_tpu.obs.metrics import MetricsCollector
+
+        gen, s = _scorer(mega=True, one_device=True)
+        s.score_batch(gen.generate_batch(BATCH), now=1000.0)
+        s.score_batch(gen.generate_batch(BATCH), now=1000.0)
+        m = MetricsCollector()
+        snap = s.kernel_snapshot()
+        m.sync_kernels(snap)
+        m.sync_kernels(snap)   # delta mirror: same snapshot never recounts
+        assert m.kernel_mega_dispatch.value() == 2.0
+        assert m.kernel_mega_fallback.value() == 0.0
+        assert m.kernel_launches_per_batch.value() == 1.0
+        s.score_batch(gen.generate_batch(1), now=1000.0)  # mega fallback
+        m.sync_kernels(s.kernel_snapshot())
+        assert m.kernel_mega_dispatch.value() == 3.0
+        assert m.kernel_mega_fallback.value() == 1.0
+        assert m.kernel_launches_per_batch.value() > 1.0
+
+    def test_stream_and_serving_render_identical(self):
+        from realtime_fraud_detection_tpu.obs.metrics import MetricsCollector
+
+        gen, s = _scorer(mega=True)
+        s.score_batch(gen.generate_batch(BATCH), now=1000.0)
+        snap = s.kernel_snapshot()
+        a, b = MetricsCollector(), MetricsCollector()
+        a.sync_kernels(snap)
+        b.sync_kernels(snap)
+
+        def mega_lines(mc):
+            return [ln for ln in mc.render_prometheus().splitlines()
+                    if "mega" in ln or "launches_per_batch" in ln]
+
+        assert mega_lines(a) and mega_lines(a) == mega_lines(b)
+        text = a.render_prometheus()
+        assert "kernel_mega_dispatch_total 1" in text
+        assert "kernel_mega_fallback_total 0" in text
+        assert "kernel_launches_per_batch 1" in text
+
+
+# ----------------------------------------------------------------- CLI
+class TestCliMegaFlags:
+    def test_parse_mega_flags(self):
+        from realtime_fraud_detection_tpu.cli import build_parser
+
+        p = build_parser()
+        for cmd in ("run-job", "serve", "bench"):
+            assert p.parse_args([cmd, "--mega"]).mega is True
+            assert p.parse_args([cmd]).mega is False
+        args = p.parse_args(["kernel-drill", "--fast", "--mega"])
+        assert args.fast and args.mega
+
+
+def test_kernel_drill_mega_fast_smoke():
+    """Tier-1 acceptance: `rtfd kernel-drill --fast --mega` passes — the
+    full kernel-plane gate PLUS the megakernel section: fused-vs-reference
+    parity under the bf16 noise bound with zero ladder flips, GEMM-tree
+    leaves exact against descend_complete_trees on the served params, the
+    megakernel dispatched with every per-site counter subsumed, zero guard
+    fallbacks, and launches-per-batch collapsed to one. Same subprocess
+    convention as the non-mega smoke (single-device serving env)."""
+    import os
+    import pathlib
+    import subprocess
+    import sys
+
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    env["XLA_FLAGS"] = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f)
+    proc = subprocess.run(
+        [sys.executable, "-m", "realtime_fraud_detection_tpu",
+         "kernel-drill", "--fast", "--mega", "--no-replay"],
+        capture_output=True, text=True, timeout=600,
+        cwd=str(pathlib.Path(__file__).resolve().parents[1]), env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = proc.stdout.strip().splitlines()
+    compact = json.loads(out[-1])               # final line: compact verdict
+    assert len(out[-1].encode()) < 2048
+    assert compact["passed"] is True
+    checks = compact["checks"]
+    assert checks["mega_reference_parity"]
+    assert checks["gemm_tree_leaves_exact"]
+    assert checks["mega_dispatched"]
+    assert checks["per_site_subsumed"]
+    assert checks["launches_collapsed_to_one"]
+    assert checks["zero_fallbacks"]
+    assert checks["zero_decision_flips"]
+    assert compact["mega"]["launches_per_batch"] == 1
+    full = json.loads(out[-2])                  # preceding line: full result
+    assert full["mega"] is True
+    assert full["divergence"]["decision_flips"] == 0

@@ -95,8 +95,16 @@ def main() -> int:
     # attention — the rtfd kernel-drill gated configuration), so one
     # relay window captures kernel-on numbers next to the f32 / --quant
     # sweeps (ROADMAP consolidated-capture item).
-    kernels = "--kernels" in sys.argv
-    _emit(stage="start", device=str(dev), quantized=quant, kernels=kernels)
+    # --mega: additionally sweep the persistent megakernel (one Pallas
+    # program scoring the whole packed microbatch — the rtfd kernel-drill
+    # --mega gated configuration) against the per-site fused chain, and
+    # emit a mega_verdict line (the attn_verdict pattern) saying whether
+    # the one-program path wins at the buckets whose VMEM plan admits it.
+    # Implies --kernels.
+    mega = "--mega" in sys.argv
+    kernels = "--kernels" in sys.argv or mega
+    _emit(stage="start", device=str(dev), quantized=quant, kernels=kernels,
+          mega=mega)
     rng = np.random.default_rng(0)
 
     # 1 ------------------------------------------------- pallas block sweep
@@ -239,6 +247,65 @@ def main() -> int:
                             params, valid), bucket, 40)
         _emit(stage="bucket", bucket=bucket, txn_per_s=round(tput, 1),
               ms_per_batch_pipelined=round(1e3 * bucket / tput, 3), **t)
+
+    # 2b --------------------------------------- megakernel sweep (--mega)
+    # Persistent megakernel vs the fused per-site chain, compiled for real
+    # on the chip, at every bucket whose VMEM plan admits the one-program
+    # path. An unsupported plan (full-size BERT params exceed the
+    # persistent grid's VMEM budget) is emitted honestly — that IS the
+    # verdict for this architecture, not an error.
+    if mega and mesh is None:
+        from realtime_fraud_detection_tpu.ops import (
+            fused_megakernel,
+            mega_launch_accounting,
+            mega_plan,
+        )
+
+        mv = tuple(True for _ in MODEL_NAMES)
+        mega_won, mega_ran = [], []
+        for bucket in (64, 128, 256):
+            host_batch = make_example_batch(
+                bucket, sc, rng=np.random.default_rng(1000 + bucket))
+            plan = mega_plan(models, bert_config, b=bucket,
+                             text_len=sc.text_len, seq_len=sc.seq_len,
+                             feature_dim=sc.feature_dim, has_two_hop=False)
+            acct = mega_launch_accounting(bucket, len(MODEL_NAMES),
+                                          mega_valid=mv)
+            if not plan["supported"]:
+                _emit(stage="mega", bucket=bucket, supported=False,
+                      param_bytes=plan["param_bytes"],
+                      act_row_bytes=plan["act_row_bytes"])
+                continue
+            batch = jax.device_put(host_batch)
+            feats = [_put(host_batch.features + np.float32(j))
+                     for j in range(8)]
+            chain_t = _time_blocked(
+                lambda i: fused(models, batch.replace(features=feats[i % 8]),
+                                params, valid), 30)
+            try:
+                mega_t = _time_blocked(
+                    lambda i: fused_megakernel(
+                        models, batch.replace(features=feats[i % 8]),
+                        params, mega_valid=mv, bert_config=bert_config,
+                        block=plan["block"]), 30)
+            except Exception as e:  # noqa: BLE001
+                _emit(stage="mega", bucket=bucket, supported=True,
+                      block=plan["block"], error=str(e)[:120])
+                continue
+            _emit(stage="mega", bucket=bucket, supported=True,
+                  block=plan["block"], chain_p50_ms=chain_t["p50_ms"],
+                  mega_p50_ms=mega_t["p50_ms"],
+                  launches_chain=acct["launches_per_batch_chain"],
+                  launches_mega=acct["launches_per_batch_mega"],
+                  hbm_bytes_eliminated=acct["intermediate_bytes_eliminated"])
+            mega_ran.append(bucket)
+            if mega_t["p50_ms"] < chain_t["p50_ms"]:
+                mega_won.append(bucket)
+        _emit(stage="mega_verdict",
+              mega_wins=bool(mega_ran) and mega_won == mega_ran,
+              buckets_ran=mega_ran, buckets_won=mega_won,
+              reason=(None if mega_ran else "no_clean_mega_measurement"),
+              drives="KernelSettings.mega() megakernel default")
 
     # 3 ------------------------------------------------ per-branch split
     from realtime_fraud_detection_tpu.models.isolation_forest import (
