@@ -242,6 +242,15 @@ def _compact_summary(result: dict) -> dict:
             "passed": (gs.get("drill") or {}).get("passed"),
         } if (gs := result.get("graph_sampling") or {})
             and not gs.get("error") else None),
+        "fleet_observability": ({
+            "passed": fo.get("passed"),
+            "overhead_ratio": fo.get("overhead_ratio"),
+            "broker_transit_p99_ms": fo.get("broker_transit_p99_ms"),
+            "stitch_rate": fo.get("stitch_rate"),
+            "crossed_process": fo.get("crossed_process"),
+            "carriers_lost": fo.get("carriers_lost"),
+        } if (fo := result.get("fleet_observability") or {})
+            and not fo.get("error") else None),
         "shard_scaling": ({
             "single_worker_txn_per_s": sh.get("single_worker_txn_per_s"),
             "aggregate_txn_per_s": sh.get("aggregate_txn_per_s"),
@@ -316,7 +325,8 @@ def _compact_summary(result: dict) -> dict:
         for victim in ("configs_txn_per_s", "operating_point", "quality",
                        "host_assembly", "mesh_scaling", "pool_scaling",
                        "autotune", "chaos", "degraded_network",
-                       "graph_sampling", "shard_scaling",
+                       "graph_sampling", "fleet_observability",
+                       "shard_scaling",
                        "elastic_scaling", "quantization", "kernel_fusion",
                        "latest_committed_tpu_capture",
                        "text_encoder", "error"):
@@ -1102,6 +1112,23 @@ def run_bench() -> None:
                 "error": f"{type(e).__name__}: {e}"[:200]}
         _log(f'graph-sampling stage done: '
              f'{ {k: v for k, v in ((result.get("graph_sampling") or {}).get("drill") or {}).items() if not isinstance(v, dict)} }')
+
+    # ------------------------------------------ fleet-observability stage
+    # Fleet observability plane (obs/): a fast no-replay obs-drill
+    # subprocess — ≥2 real OS worker processes with producer-stamped
+    # trace carriers — reporting the traced-vs-untraced overhead ratio,
+    # stitched broker-transit p99, cross-process stitch rate, and the
+    # netfault window's carrier-loss ledger. The subprocess is pinned to
+    # the CPU platform — safe on any box including a tunneled TPU
+    # session.
+    if remaining() > 90:
+        try:
+            _fleet_observability_stage(result, snapshot)
+        except Exception as e:  # noqa: BLE001
+            result["fleet_observability"] = {
+                "error": f"{type(e).__name__}: {e}"[:200]}
+        _log(f'fleet-observability stage done: '
+             f'{ {k: v for k, v in (result.get("fleet_observability") or {}).items() if not isinstance(v, (dict, list))} }')
 
     # ------------------------------------------------ shard-scaling stage
     # Partition-parallel worker plane (cluster/): aggregate virtual txn/s
@@ -2046,6 +2073,63 @@ def _graph_sampling_stage(result: dict, snapshot) -> None:
     }
     result["graph_sampling"] = stage
     snapshot("graph_sampling")
+
+
+def _fleet_observability_stage(result: dict, snapshot) -> None:
+    """Fleet-wide observability plane (ISSUE 20 bench satellite): one
+    fast, no-replay pass of ``rtfd obs-drill`` in a CPU-pinned
+    subprocess — ≥2 real OS worker processes with producer-stamped
+    trace carriers over the TCP netbroker. Reports the traced-vs-
+    untraced overhead ratio, the stitched broker-transit p99, the
+    cross-process stitch rate, and the carrier-loss ledger from the
+    netfault window. The pass/fail bar lives in ``rtfd obs-drill`` and
+    the tier-1 smoke; the bench records the headline numbers."""
+    argv = [sys.executable, "-m", "realtime_fraud_detection_tpu",
+            "obs-drill", "--fast", "--no-replay"]
+    proc = subprocess.run(argv, capture_output=True, text=True, timeout=600,
+                          env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    full: dict = {}
+    for line in reversed(proc.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                parsed = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if "breakdown_p99" in parsed and "wall" in parsed:
+                full = parsed  # the FULL result (final line = verdict)
+                break
+    if not full:
+        raise RuntimeError(
+            f"obs-drill produced no parseable result "
+            f"(rc={proc.returncode}): {(proc.stderr or '')[-200:]}")
+    wall = full.get("wall") or {}
+    stitch = full.get("stitch") or {}
+    ledger = full.get("carriers") or {}
+    p99 = full.get("breakdown_p99") or {}
+    result["fleet_observability"] = {
+        "passed": bool(full.get("passed")),
+        "failed_checks": sorted(k for k, v in
+                                (full.get("checks") or {}).items() if not v),
+        "n_workers": full.get("n_workers"),
+        "produced": full.get("produced"),
+        "overhead_ratio": wall.get("overhead_ratio"),
+        "makespan_traced_s": wall.get("makespan_traced_s"),
+        "makespan_untraced_s": wall.get("makespan_untraced_s"),
+        "broker_transit_p99_ms": (wall.get("broker_transit_ms")
+                                  or {}).get("p99"),
+        "stitch_rate": stitch.get("stitch_rate"),
+        "crossed_process": stitch.get("crossed_process"),
+        "with_remote_span": stitch.get("with_remote_span"),
+        "carriers_stripped": ledger.get("stripped"),
+        "carriers_lost": ledger.get("lost_total"),
+        "carriers_adopted": ledger.get("adopted_total"),
+        "redirects": ledger.get("redirects"),
+        "slow_worker": full.get("slow_worker"),
+        "p99_dominant_stage": p99.get("dominant_stage"),
+        "p99_dominant_worker": p99.get("dominant_worker"),
+    }
+    snapshot("fleet_observability")
 
 
 def _shard_scaling_stage(result: dict, snapshot) -> None:

@@ -142,6 +142,10 @@ DETERMINISM_MODULES = frozenset({
     # across the process boundary and must replay bit-identically inside
     # a fresh interpreter — seeded rng instances only, no global RNG
     "chaos/netfaults.py",
+    # fleet observability plane (ISSUE 20): the coordinator's metric
+    # fold and trace stitching feed obs-drill's digest — the aggregation
+    # must be a pure function of the ingested events/rings
+    "obs/fleetmetrics.py",
 })
 # Whole subsystems under the determinism contract: every cluster/ module
 # is replay-critical — ring placement, partition routing, handoff

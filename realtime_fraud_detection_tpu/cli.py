@@ -1089,7 +1089,34 @@ def cmd_trace_export(args: argparse.Namespace) -> int:
     Chrome-trace/Perfetto JSON (load in ui.perfetto.dev or
     chrome://tracing). The flight recorder's ring plus the slowest-N
     exemplars land in the file; a one-line capture summary goes to
-    stdout."""
+    stdout.
+
+    ``--merge ring_w0.json ring_w1.json ...`` skips the local capture
+    and instead folds multi-process flight-recorder ring dumps (the
+    ``{worker, pid, traces}`` shape ``rtfd obs-drill --rings-out`` and
+    the workers' bye frames emit) into ONE fleet trace: a named track
+    per OS process and the broker hop drawn as a flow arrow from the
+    producer's transit slice to the consuming worker's first slice."""
+    if getattr(args, "merge", None):
+        from realtime_fraud_detection_tpu.obs.fleetmetrics import (
+            merge_chrome_traces,
+        )
+
+        dumps = []
+        for path in args.merge:
+            with open(path) as f:
+                dumps.append(json.load(f))
+        payload = merge_chrome_traces(dumps)
+        with open(args.out, "w") as f:
+            json.dump(payload, f)
+        print(json.dumps({
+            "merged_rings": len(dumps),
+            "traces": payload["metadata"]["n_traces"],
+            "tracks": payload["metadata"]["tracks"],
+            "events": len(payload["traceEvents"]),
+            "out": args.out,
+        }))
+        return 0
     from realtime_fraud_detection_tpu.obs.tracing import Tracer
     from realtime_fraud_detection_tpu.scoring import FraudScorer, ScorerConfig
     from realtime_fraud_detection_tpu.sim.simulator import TransactionGenerator
@@ -1448,6 +1475,44 @@ def cmd_partition_drill(args: argparse.Namespace) -> int:
     return 0 if summary["passed"] else 1
 
 
+def cmd_obs_drill(args: argparse.Namespace) -> int:
+    """Deterministic distributed observability drill (obs/obs_drill.py):
+    one seeded timeline over >= 2 real OS worker processes with the
+    fleet tracing plane live — every produced record carries a wire
+    trace carrier the consuming worker re-hydrates, so stitched traces
+    span ingest -> broker transit (producer stamp vs consume stamp) ->
+    the worker's stages -> remote graph-fetch child spans to the OTHER
+    worker's fetch server. Pins: carrier losses inside the netfault
+    window counted EXACTLY (fresh local roots, never a gap or wedge),
+    fleet metric sums exactly equal the per-worker bye counters, the
+    slow-worker injection attributed to that worker's device_wait, one
+    named Chrome-trace track per process with a broker-transit flow
+    arrow per stitched trace, traced-vs-untraced makespan ratio under
+    the pinned bound, and a digest-identical second fresh run. Prints
+    the full summary, then a compact (<2 KB) verdict as the FINAL
+    stdout line (bench.py convention). Exit 1 unless every check
+    passed."""
+    import dataclasses as _dc
+
+    from realtime_fraud_detection_tpu.obs.obs_drill import (
+        ObsDrillConfig,
+        compact_obs_summary,
+        run_obs_drill,
+    )
+
+    cfg = ObsDrillConfig.fast() if args.fast else ObsDrillConfig()
+    cfg = _dc.replace(cfg, seed=args.seed,
+                      replay_check=not args.no_replay,
+                      rings_out=getattr(args, "rings_out", "") or "",
+                      **({"n_workers": args.workers} if args.workers
+                         else {}))
+    summary = run_obs_drill(cfg)
+    print(json.dumps(summary), flush=True)
+    print(json.dumps(compact_obs_summary(summary),
+                     separators=(",", ":")), flush=True)
+    return 0 if summary["passed"] else 1
+
+
 def cmd_graph_drill(args: argparse.Namespace) -> int:
     """Deterministic entity-graph drill (graph/drill.py): the typed
     user/device/merchant/IP graph maintained from the transaction flow,
@@ -1486,7 +1551,7 @@ def cmd_graph_drill(args: argparse.Namespace) -> int:
 
 def cmd_lint(args: argparse.Namespace) -> int:
     """Run the repo-native invariant checker (analysis/lint.py) — or, with
-    --lockwatch, the dynamic lock-order watcher under all twelve
+    --lockwatch, the dynamic lock-order watcher under all thirteen
     deterministic drills (analysis/lockwatch.py). Exit 0 only when clean.
 
     The static rules (wall-clock, d2h, metrics, lock-order, determinism,
@@ -2006,6 +2071,11 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--out", default="trace.json",
                     help="Chrome-trace JSON output path (open in "
                          "ui.perfetto.dev)")
+    sp.add_argument("--merge", nargs="+", default=None, metavar="RING",
+                    help="merge per-worker ring dumps ({worker, pid, "
+                         "traces} JSON, e.g. from `obs-drill "
+                         "--rings-out`) into one fleet trace instead of "
+                         "capturing locally")
     sp.set_defaults(fn=cmd_trace_export)
 
     sp = sub.add_parser("pool-drill",
@@ -2104,6 +2174,26 @@ def build_parser() -> argparse.ArgumentParser:
                     help="skip the second fresh determinism run")
     sp.set_defaults(fn=cmd_partition_drill)
 
+    sp = sub.add_parser("obs-drill",
+                        help="deterministic distributed observability "
+                             "drill: >= 2 real OS worker processes with "
+                             "cross-process trace carriers, fleet metric "
+                             "aggregation pinned exact, slow-worker p99 "
+                             "attribution, carrier loss counted under a "
+                             "netfault window, merged Chrome-trace "
+                             "export with broker-transit flow arrows")
+    sp.add_argument("--fast", action="store_true",
+                    help="tier-1 sizes (the CI smoke configuration)")
+    sp.add_argument("--workers", type=int, default=0,
+                    help="fleet size (0 = the config default)")
+    sp.add_argument("--seed", type=int, default=7)
+    sp.add_argument("--rings-out", default="",
+                    help="directory for per-worker flight-recorder ring "
+                         "dumps (the `trace-export --merge` input)")
+    sp.add_argument("--no-replay", action="store_true",
+                    help="skip the second fresh determinism run")
+    sp.set_defaults(fn=cmd_obs_drill)
+
     sp = sub.add_parser("graph-drill",
                         help="deterministic entity-graph drill: typed "
                              "user/device/merchant/IP graph + two-hop "
@@ -2129,7 +2219,7 @@ def build_parser() -> argparse.ArgumentParser:
                          "+ bench.py)")
     sp.add_argument("--format", choices=("text", "json"), default="text")
     sp.add_argument("--lockwatch", action="store_true",
-                    help="run the twelve deterministic drills under the "
+                    help="run the thirteen deterministic drills under the "
                          "instrumented lock watcher instead of the static "
                          "rules")
     sp.add_argument("--lockwatch-run", default="",

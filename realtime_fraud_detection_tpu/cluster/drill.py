@@ -110,10 +110,11 @@ class ShardDrillConfig:
 
 class _ShardPending:
     def __init__(self, records: List[Dict[str, Any]],
-                 now: Optional[float]):
+                 now: Optional[float], trace: Any = None):
         self.records = records
         self.now = now
         self.features = None
+        self.trace = trace
 
 
 class ShardScorer:
@@ -144,12 +145,24 @@ class ShardScorer:
 
     # ------------------------------------------------- dispatch / finalize
     def dispatch(self, records, now: Optional[float] = None,
-                 ) -> _ShardPending:
-        return _ShardPending(list(records), now)
+                 trace: Any = None) -> _ShardPending:
+        # trace-drill mark convention: each mark labels the interval
+        # STARTING at it; device_wait is marked at dispatch-return so it
+        # labels the in-flight dwell until finalize's mark
+        if trace is not None:
+            trace.mark("assemble")
+            trace.mark("pack")
+            trace.mark("dispatch")
+        pending = _ShardPending(list(records), now, trace)
+        if trace is not None:
+            trace.mark("device_wait")
+        return pending
 
     def finalize(self, pending: _ShardPending,
                  now: Optional[float] = None,
                  lock=None) -> List[Dict[str, Any]]:
+        if pending.trace is not None:
+            pending.trace.mark("finalize")
         return [self._score_and_update(txn) for txn in pending.records]
 
     def replay_state(self, records, now: Optional[float] = None) -> None:

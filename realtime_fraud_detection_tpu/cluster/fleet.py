@@ -93,7 +93,8 @@ class ClusterWorker:
                  group_id: str, topic: str = T.TRANSACTIONS,
                  clock: Optional[Callable[[], float]] = None,
                  max_batch: int = 128, max_delay_ms: float = 20.0,
-                 checkpoint_every: int = 8, autotune: Any = None):
+                 checkpoint_every: int = 8, autotune: Any = None,
+                 tracing: Any = None, expect_carrier: bool = False):
         self.worker_id = worker_id
         self.broker = broker
         self.scorer = scorer
@@ -107,7 +108,8 @@ class ClusterWorker:
             group_id=group_id, max_batch=max_batch,
             max_delay_ms=max_delay_ms, emit_features=False,
             emit_enriched=False, transactions_topic=topic,
-            autotune=autotune))
+            autotune=autotune, tracing=tracing,
+            expect_carrier=expect_carrier))
         # partition-scoped consumer + (virtual-clock capable) assembler
         # replace the job's defaults — the drill idiom every plane uses.
         # The job's tuning plane (if any) stays attached as the new

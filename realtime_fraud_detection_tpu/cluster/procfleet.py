@@ -113,10 +113,25 @@ class ProcessFleet:
         self.python = python
         self.ack_timeout_s = float(ack_timeout_s)
         self.spawn_env = spawn_env
+        from realtime_fraud_detection_tpu.obs.fleetmetrics import (
+            FleetMetrics,
+            FleetTraceStore,
+        )
+
         bh, _, bp = broker_addr.rpartition(":")
         self.client = NetBrokerClient(host=bh or "127.0.0.1", port=int(bp))
         hh, _, hp = handoff_addr.rpartition(":")
         self.handoff = HandoffClient(host=hh or "127.0.0.1", port=int(hp))
+        # fleet observability plane (obs/fleetmetrics.py): workers stream
+        # counter-delta ``metrics`` events (seq-deduped) into one honest
+        # aggregation, and their bye frames ship flight-recorder rings the
+        # coordinator stitches into fleet-level critical-path analysis
+        self.fleet_metrics = FleetMetrics()
+        self.fleet_traces = FleetTraceStore()
+        # worker id -> "host:port" of its graph-fetch server (published
+        # as ``fetch_addr`` events; broadcast_peers hands the full map to
+        # every worker so serve-time neighbor fetches cross the fleet)
+        self.fetch_addrs: Dict[str, str] = {}
         self.client.create_topic(CONTROL_TOPIC, 1)
         self.client.create_topic(EVENTS_TOPIC, 1)
         self._ev_pos = 0
@@ -237,11 +252,15 @@ class ProcessFleet:
             kind = ev.get("type")
             wid = str(ev.get("worker", ""))
             st = self.workers.get(wid)
-            if st is not None and kind in ("hello", "hb", "ack", "bye"):
+            if st is not None and kind in ("hello", "hb", "ack", "bye",
+                                           "metrics", "fetch_addr"):
                 # ANY event is proof of life on the control plane
                 st["last_hb"] = _mono()
             if kind == "hello" and st is not None:
                 st["ready"] = True
+                self.fleet_metrics.set_worker_info(
+                    wid, pid=ev.get("pid", st.get("pid", "")),
+                    version=ev.get("version", ""))
                 if st.get("evicted") and st["alive"] \
                         and wid not in self._pending_rejoins:
                     # an evicted worker that can reach the control plane
@@ -252,10 +271,22 @@ class ProcessFleet:
             elif kind == "ack":
                 self._acks[(wid, int(ev.get("generation", -1)),
                             str(ev.get("phase", "")))] = ev
+            elif kind == "metrics":
+                # counter-delta snapshot: seq-deduped, exactly-once fold
+                self.fleet_metrics.ingest_delta(ev)
+            elif kind == "fetch_addr":
+                self.fetch_addrs[wid] = str(ev.get("addr", ""))
             elif kind == "bye":
                 self._byes[wid] = ev
                 if st is not None:
                     st["summary"] = ev
+                ring = ev.get("trace_ring")
+                if ring:
+                    # the worker's flight recorder, stitched verbatim
+                    self.fleet_traces.ingest(
+                        wid, ring,
+                        pid=int(ev.get("pid", 0) or
+                                (st or {}).get("pid", 0) or 0))
 
     def _publish(self, msg: Dict[str, Any]) -> None:
         self.client.produce(CONTROL_TOPIC, msg, key="ctl")
@@ -589,6 +620,27 @@ class ProcessFleet:
         self.workers[wid]["proc"].wait(timeout=30)
         return self._byes[wid]
 
+    def wait_fetch_addrs(self, ids: Sequence[str],
+                         timeout_s: Optional[float] = None) -> Dict[str, str]:
+        """Block until every worker in ``ids`` has published its graph-
+        fetch server address (``fetch_addr`` event)."""
+        deadline = _mono() + (timeout_s or self.ack_timeout_s)
+        while not all(w in self.fetch_addrs for w in ids):
+            self.poll_events()
+            self._note_deaths()
+            if _mono() > deadline:
+                raise RuntimeError(
+                    f"no fetch_addr from "
+                    f"{[w for w in ids if w not in self.fetch_addrs]}")
+            time.sleep(0.02)
+        return {w: self.fetch_addrs[w] for w in ids}
+
+    def broadcast_peers(self) -> None:
+        """Publish the fleet's graph-fetch peer map over the control
+        topic: every worker builds its ``GraphFetchClient`` against every
+        OTHER worker's served address."""
+        self._publish({"type": "peers", "addrs": dict(self.fetch_addrs)})
+
     def announce_epoch(self, t0: float) -> None:
         """Publish the shared fault-window epoch over the control topic:
         workers anchor their scheduled link faults (and latency phase
@@ -747,6 +799,25 @@ def worker_main(spec: Dict[str, Any]) -> int:
     base_ms = float(spec.get("base_ms", 0.0))
     per_txn_ms = float(spec.get("per_txn_ms", 0.0))
     scorer = ShardScorer(store, base_ms=base_ms, per_txn_ms=per_txn_ms)
+    # distributed tracing (obs/tracing.py): spec["tracing"] attaches a
+    # WALL-clock tracer stamped with this worker's id as its origin —
+    # wall because stitched fleet traces need ONE shared time base
+    # across processes (t_start values must align in the merged export)
+    tracer = None
+    if spec.get("tracing"):
+        from realtime_fraud_detection_tpu.obs.tracing import Tracer
+        from realtime_fraud_detection_tpu.utils.config import (
+            TracingSettings,
+        )
+
+        tr_spec = spec["tracing"] if isinstance(spec["tracing"], dict) \
+            else {}
+        tracer = Tracer(
+            TracingSettings(
+                enabled=True,
+                ring_size=int(tr_spec.get("ring_size", 4096)),
+                origin=wid),
+            clock=_wall, origin=wid)
     autotune = None
     if spec.get("autotune"):
         from realtime_fraud_detection_tpu.utils.config import TuningSettings
@@ -765,8 +836,43 @@ def worker_main(spec: Dict[str, Any]) -> int:
         max_batch=int(spec.get("batch", 128)),
         max_delay_ms=float(spec.get("max_delay_ms", 20.0)),
         checkpoint_every=int(spec.get("checkpoint_every", 8)),
-        autotune=autotune)
+        autotune=autotune, tracing=tracer,
+        expect_carrier=bool(spec.get("expect_carrier")))
     job = worker.job
+
+    # serve-time cross-partition graph fetch (spec["fetch"]): serve this
+    # worker's local graph view to peers, and once the coordinator
+    # broadcasts the fleet's peer map, resolve remote neighbor shares
+    # per microbatch — each RPC records a remote_fetch child span on the
+    # batch's trace, so the stitched trace shows the peer hop
+    fetch_srv = None
+    fetch_client_box: Dict[str, Any] = {"client": None}
+    fetch_cfg = spec.get("fetch") if isinstance(spec.get("fetch"), dict) \
+        else ({} if spec.get("fetch") else None)
+    if fetch_cfg is not None:
+        from realtime_fraud_detection_tpu.graph.fetch import (
+            GraphFetchServer,
+        )
+
+        fetch_srv = GraphFetchServer(
+            lambda: store.graph, worker_id=wid,
+            host="127.0.0.1", port=0).start()
+
+    def _remote_fetch(ctx, batch) -> None:
+        """Resolve remote adjacency for this batch's users (budget- and
+        deadline-bounded; degrade-to-local on any failure)."""
+        fc = fetch_client_box["client"]
+        if fc is None:
+            return
+        trace = getattr(ctx, "trace", None) if ctx is not None else None
+        fc.begin_batch(trace=trace)
+        ids = sorted({str(r.value.get("user_id", ""))
+                      for r in batch if isinstance(r.value, dict)})
+        ids = [i for i in ids if i][: int(fetch_cfg.get("ids", 16))]
+        if ids:
+            fc.fetch(str(fetch_cfg.get("edge", "user->device")), ids,
+                     fanout=int(fetch_cfg.get("k", 4)))
+        fc.end_batch()
 
     stop = {"reason": None}
 
@@ -779,8 +885,15 @@ def worker_main(spec: Dict[str, Any]) -> int:
     # control cursor starts at the topic END: assignments published before
     # this worker existed are history, not instructions
     ctl_pos = client.end_offsets(CONTROL_TOPIC)[0]
+    from realtime_fraud_detection_tpu import __version__
+
     client.produce(EVENTS_TOPIC, {"type": "hello", "worker": wid,
-                                  "pid": os.getpid()}, key=wid)
+                                  "pid": os.getpid(),
+                                  "version": __version__}, key=wid)
+    if fetch_srv is not None:
+        client.produce(EVENTS_TOPIC, {
+            "type": "fetch_addr", "worker": wid,
+            "addr": f"127.0.0.1:{fetch_srv.port}"}, key=wid)
 
     in_flight: deque = deque()        # (ctx, done_at_wall, depth)
     busy_until = 0.0
@@ -842,6 +955,7 @@ def worker_main(spec: Dict[str, Any]) -> int:
             if not batch:
                 break
             ctx = job.dispatch_batch(batch, now=_wall())
+            _remote_fetch(ctx, batch)
             _complete(ctx, _wall() + scorer.cost_s(len(batch)),
                       job._inflight_depth())
 
@@ -872,6 +986,24 @@ def worker_main(spec: Dict[str, Any]) -> int:
             # the drill coordinator's shared window epoch (netfault
             # schedules + phase classification are relative to it)
             epoch["t0"] = float(msg["t0"])
+        elif kind == "peers" and fetch_cfg is not None:
+            from realtime_fraud_detection_tpu.graph.fetch import (
+                GraphFetchClient,
+            )
+
+            addrs = {str(p): a for p, a in (msg.get("addrs") or {}).items()
+                     if str(p) != wid and a}
+            peers = {}
+            for p, a in addrs.items():
+                h, _, prt = str(a).rpartition(":")
+                peers[p] = (h or "127.0.0.1", int(prt))
+            old = fetch_client_box["client"]
+            if old is not None:
+                old.close()
+            fetch_client_box["client"] = GraphFetchClient(
+                peers,
+                deadline_ms=float(fetch_cfg.get("deadline_ms", 25.0)),
+                node_budget=int(fetch_cfg.get("node_budget", 64)))
         elif kind == "assign":
             gen = int(msg.get("generation", 0))
             assignment = msg.get("assignment") or {}
@@ -906,6 +1038,8 @@ def worker_main(spec: Dict[str, Any]) -> int:
                 # rebalance fences our partitions (StaleGenerationError
                 # -> _abandon), closing the zombie-writer window
                 client.generation = gen
+                if fetch_client_box["client"] is not None:
+                    fetch_client_box["client"].set_generation(gen)
                 counts = worker.set_assignment(mine)
                 client.produce(EVENTS_TOPIC, {
                     "type": "ack", "worker": wid, "generation": gen,
@@ -914,6 +1048,43 @@ def worker_main(spec: Dict[str, Any]) -> int:
                     "replayed": counts["replayed"]}, key=wid)
         elif kind == "shutdown" and str(msg.get("worker")) == wid:
             stop["reason"] = "shutdown"
+
+    # fleet-metrics publishing (obs/fleetmetrics.py ingests these): the
+    # worker ships counter DELTAS with a monotonic seq, and advances its
+    # last-sent baseline only AFTER the produce returns — a netfault-
+    # dropped publish is retried as a larger delta next interval, never
+    # lost, so the coordinator's fleet sums stay exact
+    met: Dict[str, Any] = {"seq": 0, "last": {}}
+
+    def _metric_counters() -> Dict[str, float]:
+        cur: Dict[str, float] = {str(k): float(v)
+                                 for k, v in job.counters.items()}
+        if tracer is not None:
+            for k, v in tracer.counters.items():
+                cur[f"trace_{k}"] = float(v)
+        fc = fetch_client_box["client"]
+        if fc is not None:
+            cur["remote_fetch"] = float(fc.remote_fetch_total)
+            cur["remote_fetch_errors"] = float(fc.fetch_error_total)
+        return cur
+
+    def _publish_metrics() -> None:
+        cur = _metric_counters()
+        # the FIRST snapshot ships every key (zeros included) so the
+        # fleet exposition carries the full series set from the start
+        # and the final fold equals the bye counters key for key;
+        # afterwards only changed keys ride each delta
+        delta = cur if met["seq"] == 0 else {
+            k: v - met["last"].get(k, 0.0)
+            for k, v in cur.items()
+            if k not in met["last"] or v != met["last"][k]}
+        if not delta and met["seq"] > 0:
+            return
+        client.produce(EVENTS_TOPIC, {
+            "type": "metrics", "worker": wid, "seq": met["seq"] + 1,
+            "counters": delta}, key=wid)
+        met["seq"] += 1
+        met["last"] = cur
 
     def _say_bye() -> None:
         from realtime_fraud_detection_tpu.obs.profiling import (
@@ -942,8 +1113,16 @@ def worker_main(spec: Dict[str, Any]) -> int:
                     "p50_ms": round(interpolated_percentile(s, 0.50), 3),
                     "p99_ms": round(interpolated_percentile(s, 0.99), 3),
                 }
+        # final delta BEFORE the bye: the coordinator's streamed fleet
+        # sums equal these bye counters exactly (the obs-drill pin) —
+        # best-effort; a dead broker here still gets the bye attempt
+        try:
+            _publish_metrics()
+        except (ConnectionError, OSError):
+            pass
         bye = {"type": "bye", "worker": wid, "graceful": True,
                "reason": stop["reason"], "final_checkpoints": n_ckpt,
+               "pid": os.getpid(),
                "digests": digests, "counters": dict(job.counters),
                "checkpoints": worker.checkpoints,
                "replayed_total": worker.replayed_total,
@@ -957,6 +1136,16 @@ def worker_main(spec: Dict[str, Any]) -> int:
             bye["autotune"] = {
                 "inflight_depth": snap["tuner"]["inflight_depth"],
                 "counters": snap["tuner"]["counters"]}
+        if tracer is not None:
+            # the flight recorder rides the bye verbatim: the coordinator
+            # stitches every worker's ring into the fleet trace store
+            bye["trace_ring"] = [ct.to_dict() for ct in tracer.traces()]
+            bye["tracer_counters"] = dict(tracer.counters)
+        fc = fetch_client_box["client"]
+        if fc is not None:
+            bye["fetch"] = fc.stats()
+        if fetch_srv is not None:
+            bye["fetch_served"] = fetch_srv.requests_total
         client.produce(EVENTS_TOPIC, bye, key=wid)
 
     hb_s = float(spec.get("heartbeat_s", 1.0))
@@ -1005,6 +1194,13 @@ def worker_main(spec: Dict[str, Any]) -> int:
                                        key=wid)
                     except (ConnectionError, OSError):
                         pass
+                    try:
+                        # rides the heartbeat cadence; baseline advances
+                        # only on a successful produce (inside), so a
+                        # fault window folds into the next delta
+                        _publish_metrics()
+                    except (ConnectionError, OSError):
+                        pass
                 # ---- fenced: rejoin as a fresh member once the control
                 # plane lets a hello through (cursor jumps to the topic
                 # END first — pre-eviction assignments are history)
@@ -1028,6 +1224,7 @@ def worker_main(spec: Dict[str, Any]) -> int:
                     if batch:
                         now = _wall()
                         ctx = job.dispatch_batch(batch, now=now)
+                        _remote_fetch(ctx, batch)
                         start = max(now, busy_until)
                         done = start + scorer.cost_s(len(batch))
                         busy_until = done
@@ -1054,5 +1251,10 @@ def worker_main(spec: Dict[str, Any]) -> int:
                 conn_backoff.sleep(min(conn_attempt, 8))
                 conn_attempt += 1
     finally:
+        fc = fetch_client_box["client"]
+        if fc is not None:
+            fc.close()
+        if fetch_srv is not None:
+            fetch_srv.stop()
         client.close()
         handoff.close()

@@ -168,11 +168,17 @@ class GraphFetchServer:
             ids = ids[: self.max_ids_per_request]
             graph = self._graph_source()
             k = req.get("k")
+            # server-side child-span timing: the handling duration rides
+            # the reply frame so the CLIENT's remote_fetch span can report
+            # its server share (wire time = client span - srv_ms)
+            t0 = time.perf_counter()  # rtfd-lint: allow[wall-clock] real RPC handling time reported to the caller
+            neighbors = graph.neighbor_map(
+                str(req.get("edge")), ids,
+                int(k) if k is not None else None)
             return {
                 "worker": self.worker_id,
-                "neighbors": graph.neighbor_map(
-                    str(req.get("edge")), ids,
-                    int(k) if k is not None else None),
+                "neighbors": neighbors,
+                "srv_ms": round((time.perf_counter() - t0) * 1e3, 4),  # rtfd-lint: allow[wall-clock] real RPC handling time reported to the caller
             }
         if op == "ping":
             return {"pong": True, "worker": self.worker_id}
@@ -237,6 +243,10 @@ class GraphFetchClient:
         self.budget_exhausted_total = 0    # batches that hit the node budget
         self.stale_generation_total = 0    # fenced-generation refusals
         self.degraded_batches_total = 0    # batches with ANY degrade cause
+        # distributed-tracing seam: the active batch's TraceBatch (set by
+        # begin_batch(trace=...)); every peer call records a remote_fetch
+        # child span on it, with the server's own srv_ms from the reply
+        self._trace: Optional[Any] = None
 
     # ------------------------------------------------------------ lifecycle
     def close(self) -> None:
@@ -252,14 +262,18 @@ class GraphFetchClient:
         self.generation = int(generation)
 
     # ------------------------------------------------------------ batch API
-    def begin_batch(self) -> None:
+    def begin_batch(self, trace: Optional[Any] = None) -> None:
         """Open one microbatch's remote-resolution window: a fresh node
         budget and ONE absolute deadline shared by every fetch in the
-        batch."""
+        batch. ``trace`` (a ``TraceBatch``) attaches the tracing plane:
+        each peer call then records a ``remote_fetch`` child span carved
+        out of the enclosing stage, carrying the server-side ``srv_ms``
+        returned in the reply frame."""
         self._batch_deadline = self._clock() + self.deadline_ms / 1e3
         self._budget_left = self.node_budget
         self._batch_degraded = False
         self._batch_deadline_hit = False
+        self._trace = trace
 
     def end_batch(self) -> bool:
         """Close the window; True (and counted) when any fetch degraded.
@@ -270,6 +284,7 @@ class GraphFetchClient:
             self.fetch_deadline_total += 1
         if self._batch_degraded:
             self.degraded_batches_total += 1
+        self._trace = None
         return self._batch_degraded
 
     # -------------------------------------------------------------- fetch
@@ -305,6 +320,16 @@ class GraphFetchClient:
                 degraded = True
                 break
             resp = self._call_peer(peer, req)
+            if self._trace is not None:
+                # client span (wall of the whole RPC) + the server-side
+                # child duration from the reply frame: the stitched trace
+                # shows both the worker's wait and the peer's handling
+                self._trace.child_span(
+                    "remote_fetch", (self._clock() - now) * 1e3,
+                    peer=peer,
+                    server=(resp or {}).get("worker", ""),
+                    srv_ms=float((resp or {}).get("srv_ms", 0.0) or 0.0),
+                    error=resp is None)
             if resp is None:
                 degraded = True
                 continue

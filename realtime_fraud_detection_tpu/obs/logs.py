@@ -46,6 +46,23 @@ class JsonFormatter(logging.Formatter):
         }
         if self.service_name:
             out["service"] = self.service_name
+        # log/trace correlation: while a traced microbatch is in flight
+        # on this thread, every JSON line carries its lead trace id (and
+        # the worker origin), so flight-recorder exemplars are greppable
+        # straight from the logs. Lazy import — logging must configure
+        # even if the tracing plane never loads.
+        try:
+            from realtime_fraud_detection_tpu.obs.tracing import (
+                current_log_context,
+            )
+
+            ctx = current_log_context()
+        except Exception:  # noqa: BLE001 - logging never raises
+            ctx = None
+        if ctx is not None and "trace_id" not in record.__dict__:
+            out["trace_id"] = ctx["trace_id"]
+            if ctx["worker"]:
+                out["worker"] = ctx["worker"]
         for k, v in record.__dict__.items():
             if k not in _RESERVED and not k.startswith("_"):
                 out[k] = v

@@ -482,6 +482,17 @@ class MetricsCollector:
         self.trace_slo_violations = r.counter(
             "trace_slo_violations_total",
             "Transactions that blew the SLO latency objective")
+        # cross-process carrier plane: adopted = producer-stamped trace
+        # contexts re-hydrated at consume time (stitched traces); lost =
+        # expected-but-missing/garbled carriers degraded to fresh local
+        # roots (netfault-window drops land here — counted, never a gap)
+        self.trace_carrier_adopted = r.counter(
+            "trace_carrier_adopted_total",
+            "Producer-stamped trace carriers adopted at consume time")
+        self.trace_carrier_lost = r.counter(
+            "trace_carrier_lost_total",
+            "Expected trace carriers missing/unparseable — degraded to "
+            "fresh local roots")
         self.trace_slo_burn = r.gauge(
             "trace_slo_burn_rate",
             "SLO error-budget burn rate (1.0 = budget consumed exactly at "
@@ -954,6 +965,14 @@ class MetricsCollector:
             delta = float(total) - float(self._trace_seen.get(seen_key, 0.0))
             if delta > 0:
                 self.trace_completed.inc(delta, terminal=terminal)
+            self._trace_seen[seen_key] = float(total)
+        for key, counter in (("carrier_adopted", self.trace_carrier_adopted),
+                             ("carrier_lost", self.trace_carrier_lost)):
+            total = counters.get(key, 0)
+            seen_key = ("carrier", key)
+            delta = float(total) - float(self._trace_seen.get(seen_key, 0.0))
+            if delta > 0:
+                counter.inc(delta)
             self._trace_seen[seen_key] = float(total)
         slo = snapshot.get("slo") or {}
         seen_key = ("slo", "violations")

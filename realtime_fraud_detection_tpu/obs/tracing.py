@@ -48,26 +48,125 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 __all__ = [
     "TRACE_STAGES",
     "TRACE_STAGE_BUCKETS_MS",
+    "CARRIER_KEY",
+    "make_carrier",
+    "parse_carrier",
     "TraceContext",
     "TraceBatch",
     "CompletedTrace",
     "SloTracker",
     "Tracer",
+    "set_log_context",
+    "clear_log_context",
+    "current_log_context",
 ]
 
-# Canonical stage order: ``ingest`` is the broker→admission lag, ``queue``
-# the microbatch assembly wait; the rest are the batch-granular pipeline
-# stages. ``device_wait`` spans launch-returned → result-in-hand, so under
-# pipelining it absorbs the in-flight dwell (that time IS the batch's
-# device+queue residency from the transaction's point of view).
-TRACE_STAGES = ("ingest", "queue", "assemble", "pack", "dispatch",
-                "device_wait", "finalize")
+# Canonical stage order: ``ingest`` is the gateway→produce lag,
+# ``broker_transit`` the produce→consume transit (producer wall stamp in
+# the carrier vs consume wall stamp — the cross-process segment),
+# ``redirect_hops`` time burnt on 421 wrong-shard bounces before the
+# record reached its owner, ``queue`` the microbatch assembly wait; the
+# rest are the batch-granular pipeline stages. ``device_wait`` spans
+# launch-returned → result-in-hand, so under pipelining it absorbs the
+# in-flight dwell (that time IS the batch's device+queue residency from
+# the transaction's point of view). ``remote_fetch`` is carved OUT of
+# its enclosing stage by the child-span bookkeeping (graph-fetch RPCs
+# issued mid-dispatch), so the stages stay additive over e2e.
+TRACE_STAGES = ("ingest", "broker_transit", "redirect_hops", "queue",
+                "assemble", "pack", "dispatch", "device_wait",
+                "remote_fetch", "finalize")
 
 # trace_stage_ms histogram bounds (milliseconds). Shared with
 # obs.metrics.MetricsCollector.sync_tracing: the tracer aggregates into
 # exactly these buckets so the Prometheus mirror is a pure counter-delta.
 TRACE_STAGE_BUCKETS_MS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
                           20.0, 50.0, 100.0, 500.0)
+
+# ---------------------------------------------------------------------------
+# cross-process trace carrier
+# ---------------------------------------------------------------------------
+
+# Producers stamp the carrier INTO the record value (next to ``ingest_ts``),
+# so it rides ``produce_batch_stamped`` framing across the in-memory broker
+# and the TCP netbroker verbatim; consumers read it from the RAW record
+# value before sanitize strips unknown fields.
+CARRIER_KEY = "trace_carrier"
+
+
+def make_carrier(trace_id: str, origin: str = "",
+                 produced_ts: Optional[float] = None, priority: str = "",
+                 fault: str = "", parent: str = "", hops: int = 0,
+                 redirect_s: float = 0.0) -> Dict[str, Any]:
+    """Compact wire form of a trace context (the keys are the format):
+
+    ``v`` version, ``tid`` trace id, ``sp`` parent span id, ``org``
+    producing process (gateway / serving / worker id), ``ts`` producer
+    WALL stamp (consume-wall minus it = ``broker_transit``), ``pr`` QoS
+    priority, ``flt`` producer-side fault context, ``rh``/``rs``
+    421-redirect hop count and accumulated redirect seconds. Empty
+    fields are omitted — the carrier stays a handful of bytes.
+    """
+    c: Dict[str, Any] = {"v": 1, "tid": str(trace_id)}
+    if parent:
+        c["sp"] = str(parent)
+    if origin:
+        c["org"] = str(origin)
+    if produced_ts is not None:
+        c["ts"] = round(float(produced_ts), 6)
+    if priority:
+        c["pr"] = str(priority)
+    if fault:
+        c["flt"] = str(fault)
+    if hops:
+        c["rh"] = int(hops)
+    if redirect_s:
+        c["rs"] = round(float(redirect_s), 6)
+    return c
+
+
+def parse_carrier(obj: Any) -> Optional[Dict[str, Any]]:
+    """Validate a wire carrier; None = unusable (counted as carrier loss
+    by ``Tracer.begin`` when one was expected — a fresh root, never a
+    wedge)."""
+    if not isinstance(obj, dict):
+        return None
+    tid = obj.get("tid")
+    if not isinstance(tid, str) or not tid:
+        return None
+    out: Dict[str, Any] = {"tid": tid,
+                           "sp": str(obj.get("sp", "") or ""),
+                           "org": str(obj.get("org", "") or ""),
+                           "pr": str(obj.get("pr", "") or ""),
+                           "flt": str(obj.get("flt", "") or "")}
+    for key, cast in (("ts", float), ("rh", int), ("rs", float)):
+        try:
+            out[key] = cast(obj[key])
+        except (KeyError, TypeError, ValueError):
+            pass
+    return out
+
+
+# Log/trace correlation seam: ``Tracer.batch`` publishes the active batch's
+# lead trace id (+ worker origin) thread-locally; ``obs.logs.JsonFormatter``
+# consults it so flight-recorder exemplars are greppable in the JSON logs.
+_log_ctx = threading.local()
+
+
+def set_log_context(trace_id: str, worker: str = "") -> None:
+    _log_ctx.trace_id = str(trace_id)
+    _log_ctx.worker = str(worker)
+
+
+def clear_log_context() -> None:
+    _log_ctx.trace_id = ""
+    _log_ctx.worker = ""
+
+
+def current_log_context() -> Optional[Dict[str, str]]:
+    tid = getattr(_log_ctx, "trace_id", "")
+    if not tid:
+        return None
+    return {"trace_id": tid, "worker": getattr(_log_ctx, "worker", "")}
 
 
 class TraceContext:
@@ -77,15 +176,27 @@ class TraceContext:
     trace so queue-wait attribution can split by class."""
 
     __slots__ = ("trace_id", "txn_id", "t_admit", "ingest_lag_s",
-                 "priority")
+                 "priority", "broker_transit_s", "redirect_s", "hops",
+                 "origin", "parent", "fault")
 
     def __init__(self, trace_id: str, txn_id: str, t_admit: float,
-                 ingest_lag_s: float = 0.0, priority: str = ""):
+                 ingest_lag_s: float = 0.0, priority: str = "",
+                 broker_transit_s: float = 0.0, redirect_s: float = 0.0,
+                 hops: int = 0, origin: str = "", parent: str = "",
+                 fault: str = ""):
         self.trace_id = trace_id
         self.txn_id = txn_id
         self.t_admit = t_admit
         self.ingest_lag_s = ingest_lag_s
         self.priority = priority
+        # carrier-adopted cross-process segments (wall-minus-wall deltas
+        # carried as durations, the ingest-lag clock discipline)
+        self.broker_transit_s = broker_transit_s
+        self.redirect_s = redirect_s
+        self.hops = hops
+        self.origin = origin            # producing process ("" = local root)
+        self.parent = parent            # producer-side parent span id
+        self.fault = fault              # producer-side fault context
 
 
 class TraceBatch:
@@ -98,7 +209,7 @@ class TraceBatch:
     completed traces.
     """
 
-    __slots__ = ("tracer", "contexts", "marks", "meta")
+    __slots__ = ("tracer", "contexts", "marks", "meta", "spans")
 
     def __init__(self, tracer: "Tracer", contexts: List[TraceContext],
                  meta: Optional[Dict[str, Any]] = None):
@@ -106,9 +217,21 @@ class TraceBatch:
         self.contexts = contexts
         self.marks: List[Tuple[str, float]] = []
         self.meta: Dict[str, Any] = dict(meta or {})
+        # child spans carved OUT of their enclosing stage at finish time:
+        # (enclosing mark index, span name, duration ms, span meta)
+        self.spans: List[Tuple[int, str, float, Dict[str, Any]]] = []
 
     def mark(self, stage: str) -> None:
         self.marks.append((stage, self.tracer._clock()))
+
+    def child_span(self, name: str, dur_ms: float, **meta: Any) -> None:
+        """Record a sub-operation (a remote graph-fetch RPC, say) inside
+        the CURRENT stage. ``finish_batch`` subtracts the span from its
+        enclosing stage and books it under its own name, so the stage
+        table stays additive over e2e while the remote time is visible
+        as a first-class stage."""
+        self.spans.append((len(self.marks) - 1, str(name),
+                           max(0.0, float(dur_ms)), meta))
 
     def annotate(self, **kv: Any) -> None:
         self.meta.update(kv)
@@ -118,10 +241,10 @@ class CompletedTrace:
     """An immutable completed trace row in the flight recorder."""
 
     __slots__ = ("trace_id", "txn_id", "t_start", "e2e_ms", "stages",
-                 "meta", "terminal", "priority")
+                 "meta", "terminal", "priority", "origin", "parent")
 
     def __init__(self, trace_id, txn_id, t_start, e2e_ms, stages, meta,
-                 terminal, priority=""):
+                 terminal, priority="", origin="", parent=""):
         self.trace_id = trace_id
         self.txn_id = txn_id
         self.t_start = t_start          # tracer-clock start (admit - queue)
@@ -130,17 +253,25 @@ class CompletedTrace:
         self.meta = meta
         self.terminal = terminal        # scored | shed | error | cached
         self.priority = priority        # QoS class ("" = unclassified)
+        self.origin = origin            # carrier origin ("" = local root)
+        self.parent = parent            # carrier parent span id
 
     def to_dict(self) -> Dict[str, Any]:
-        return {
+        out = {
             "trace_id": self.trace_id,
             "txn_id": self.txn_id,
+            "t_start": round(self.t_start, 6),
             "e2e_ms": round(self.e2e_ms, 4),
             "stages": {k: round(v, 4) for k, v in self.stages.items()},
             "meta": self.meta,
             "terminal": self.terminal,
             "priority": self.priority,
         }
+        if self.origin:
+            out["origin"] = self.origin
+        if self.parent:
+            out["parent"] = self.parent
+        return out
 
 
 class SloTracker:
@@ -271,7 +402,8 @@ class Tracer:
     clock.
     """
 
-    def __init__(self, settings: Optional[Any] = None, clock=time.monotonic):
+    def __init__(self, settings: Optional[Any] = None, clock=time.monotonic,
+                 origin: str = ""):
         from realtime_fraud_detection_tpu.utils.config import TracingSettings
 
         self.settings = settings if settings is not None else TracingSettings(
@@ -279,6 +411,12 @@ class Tracer:
         self.enabled = bool(getattr(self.settings, "enabled", True))
         self._clock = clock
         self._lock = threading.Lock()
+        # process identity stamped into minted trace ids and carriers —
+        # what keeps two workers' fresh roots globally distinct when the
+        # coordinator stitches their rings ("" keeps the single-process
+        # id format unchanged)
+        self.origin = str(origin
+                          or getattr(self.settings, "origin", "") or "")
         s = self.settings
         self._ring: deque = deque(maxlen=max(16, int(s.ring_size)))
         self._slowest: List[Tuple[float, int, CompletedTrace]] = []
@@ -287,7 +425,7 @@ class Tracer:
         self._stage_agg: Dict[str, _StageAgg] = {}
         self.counters: Dict[str, int] = {
             "started": 0, "completed": 0, "shed": 0, "errors": 0,
-            "cached": 0,
+            "cached": 0, "carrier_adopted": 0, "carrier_lost": 0,
         }
         # active fault-window attribution (chaos plane): while set, every
         # trace closed — scored, shed, errored, terminal — carries
@@ -304,27 +442,85 @@ class Tracer:
         )
 
     # ------------------------------------------------------------- lifecycle
+    def _next_id(self) -> str:
+        n = next(self._seq)
+        return f"t{self.origin}-{n:08x}" if self.origin else f"t{n:08x}"
+
     def begin(self, txn_id: str, ingest_lag_s: float = 0.0,
-              t_admit: Optional[float] = None,
-              priority: str = "") -> Optional[TraceContext]:
+              t_admit: Optional[float] = None, priority: str = "",
+              carrier: Any = None, now_wall: Optional[float] = None,
+              expect_carrier: bool = False) -> Optional[TraceContext]:
         """Open a trace at admission. Returns None when disabled — every
         downstream call site guards on the context, so the disabled plane
         costs one branch. ``priority`` is the QoS class the admission path
-        assigned (queue-wait attribution splits on it)."""
+        assigned (queue-wait attribution splits on it).
+
+        ``carrier`` re-hydrates a producer-stamped wire carrier: the
+        trace ADOPTS the producer's trace id (stitching key), priority,
+        fault context and redirect ledger, and ``broker_transit`` becomes
+        ``now_wall`` (consume wall stamp) minus the carrier's produce
+        stamp — the ingest lag is reduced by the same amount so the
+        pre-admission segments never double-count one interval. A
+        missing or unparseable carrier where one was expected
+        (``expect_carrier``, or a present-but-garbled frame) degrades to
+        a fresh LOCAL root, counted in ``carrier_lost`` — never a gap,
+        never a wedge."""
         if not self.enabled:
             return None
         self.counters["started"] += 1
+        tid = ""
+        parent = origin = fault = ""
+        transit = redirect = 0.0
+        hops = 0
+        pr = str(priority)
+        if carrier is not None or expect_carrier:
+            c = parse_carrier(carrier)
+            if c is None:
+                self.counters["carrier_lost"] += 1
+            else:
+                self.counters["carrier_adopted"] += 1
+                tid = c["tid"]
+                parent, origin, fault = c["sp"], c["org"], c["flt"]
+                if not pr:
+                    pr = c["pr"]
+                ts = c.get("ts")
+                if ts is not None and now_wall is not None:
+                    transit = max(0.0, float(now_wall) - ts)
+                hops = int(c.get("rh", 0))
+                redirect = max(0.0, float(c.get("rs", 0.0)))
+        ingest = max(0.0, float(ingest_lag_s))
+        if transit > 0.0:
+            # ingest_ts and the carrier's produce stamp bracket the same
+            # wall interval's two ends: keep ingest = submit→produce,
+            # transit = produce→consume, additive by construction
+            ingest = max(0.0, ingest - transit)
         return TraceContext(
-            f"t{next(self._seq):08x}", str(txn_id),
+            tid or self._next_id(), str(txn_id),
             self._clock() if t_admit is None else t_admit,
-            max(0.0, float(ingest_lag_s)), str(priority))
+            ingest, pr, broker_transit_s=transit, redirect_s=redirect,
+            hops=hops, origin=origin, parent=parent, fault=fault)
+
+    def root_carrier(self, produced_ts: Optional[float] = None,
+                     priority: str = "") -> Optional[Dict[str, Any]]:
+        """Mint a wire carrier for a record THIS process produces but
+        will never score (gateway/serving → broker): a fresh distributed
+        trace id plus the producer wall stamp the consumer turns into
+        ``broker_transit``. Returns None when disabled."""
+        if not self.enabled:
+            return None
+        return make_carrier(self._next_id(), origin=self.origin,
+                            produced_ts=produced_ts, priority=priority,
+                            fault=self.fault_context)
 
     def batch(self, contexts: Sequence[Optional[TraceContext]],
               **meta: Any) -> Optional[TraceBatch]:
-        """Bind admitted contexts into one microbatch carrier."""
+        """Bind admitted contexts into one microbatch carrier. Publishes
+        the lead trace id thread-locally (``current_log_context``) so JSON
+        log lines emitted while the batch is in flight carry it."""
         ctxs = [c for c in contexts if c is not None]
         if not self.enabled or not ctxs:
             return None
+        set_log_context(ctxs[0].trace_id, self.origin)
         return TraceBatch(self, ctxs, meta)
 
     def set_fault_context(self, name: str) -> None:
@@ -347,15 +543,25 @@ class Tracer:
         if trace is None:
             return
         now = self._clock()
+        clear_log_context()
         if self.fault_context:
             trace.meta = dict(trace.meta)
             trace.meta["fault"] = self.fault_context
+        if trace.spans:
+            trace.meta = dict(trace.meta)
+            trace.meta["spans"] = [
+                {"name": name, "ms": round(ms, 4), **smeta}
+                for _, name, ms, smeta in trace.spans]
         marks = trace.marks
         completed: List[CompletedTrace] = []
         for ctx in trace.contexts:
             stages: Dict[str, float] = {}
             if ctx.ingest_lag_s > 0.0:
                 stages["ingest"] = ctx.ingest_lag_s * 1e3
+            if ctx.broker_transit_s > 0.0:
+                stages["broker_transit"] = ctx.broker_transit_s * 1e3
+            if ctx.hops or ctx.redirect_s > 0.0:
+                stages["redirect_hops"] = ctx.redirect_s * 1e3
             if marks:
                 stages["queue"] = max(0.0, marks[0][1] - ctx.t_admit) * 1e3
                 for i, (name, t0) in enumerate(marks):
@@ -363,11 +569,25 @@ class Tracer:
                     stages[name] = max(0.0, t1 - t0) * 1e3
             else:
                 stages["queue"] = max(0.0, now - ctx.t_admit) * 1e3
-            e2e_ms = (ctx.ingest_lag_s + max(0.0, now - ctx.t_admit)) * 1e3
+            for idx, name, ms, _smeta in trace.spans:
+                # carve the child span out of its enclosing stage so the
+                # table stays additive (a span before the first mark came
+                # out of the queue wait)
+                encl = marks[idx][0] if 0 <= idx < len(marks) else "queue"
+                if encl in stages:
+                    stages[encl] = max(0.0, stages[encl] - ms)
+                stages[name] = stages.get(name, 0.0) + ms
+            pre = (ctx.ingest_lag_s + ctx.broker_transit_s
+                   + ctx.redirect_s)
+            e2e_ms = (pre + max(0.0, now - ctx.t_admit)) * 1e3
+            meta = trace.meta
+            if ctx.fault and "fault" not in meta:
+                meta = dict(meta)
+                meta["fault"] = ctx.fault
             completed.append(CompletedTrace(
-                ctx.trace_id, ctx.txn_id,
-                ctx.t_admit - ctx.ingest_lag_s, e2e_ms, stages,
-                trace.meta, terminal, ctx.priority))
+                ctx.trace_id, ctx.txn_id, ctx.t_admit - pre, e2e_ms,
+                stages, meta, terminal, ctx.priority,
+                origin=ctx.origin, parent=ctx.parent))
         with self._lock:
             for ct in completed:
                 self._record_locked(ct, now)
@@ -381,16 +601,24 @@ class Tracer:
         if ctx is None:
             return
         now = self._clock()
-        e2e_ms = (ctx.ingest_lag_s + max(0.0, now - ctx.t_admit)) * 1e3
+        pre = ctx.ingest_lag_s + ctx.broker_transit_s + ctx.redirect_s
+        e2e_ms = (pre + max(0.0, now - ctx.t_admit)) * 1e3
         stages = {"queue": max(0.0, now - ctx.t_admit) * 1e3}
         if ctx.ingest_lag_s > 0.0:
             stages["ingest"] = ctx.ingest_lag_s * 1e3
+        if ctx.broker_transit_s > 0.0:
+            stages["broker_transit"] = ctx.broker_transit_s * 1e3
+        if ctx.hops or ctx.redirect_s > 0.0:
+            stages["redirect_hops"] = ctx.redirect_s * 1e3
         meta = dict(meta)
         if self.fault_context:
             meta.setdefault("fault", self.fault_context)
+        if ctx.fault:
+            meta.setdefault("fault", ctx.fault)
         ct = CompletedTrace(ctx.trace_id, ctx.txn_id,
-                            ctx.t_admit - ctx.ingest_lag_s, e2e_ms, stages,
-                            meta, terminal, ctx.priority)
+                            ctx.t_admit - pre, e2e_ms, stages,
+                            meta, terminal, ctx.priority,
+                            origin=ctx.origin, parent=ctx.parent)
         with self._lock:
             self._record_locked(ct, now)
 

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
+import os
 import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
@@ -126,6 +127,16 @@ class ServingApp:
             from realtime_fraud_detection_tpu.obs.tracing import Tracer
 
             self.tracer = Tracer(self.config.tracing)
+        # fleet metrics aggregation (obs/fleetmetrics.py): per-worker
+        # counter snapshots folded into one exposition at GET
+        # /metrics/fleet — a ProcessFleet coordinator (or harness) feeds
+        # worker snapshots in through this attribute; this process's own
+        # tracer counters fold in at render time under its worker id
+        from realtime_fraud_detection_tpu.obs.fleetmetrics import (
+            FleetMetrics,
+        )
+
+        self.fleet_metrics = FleetMetrics()
         two_phase = sc.overlap_assembly or self.pool is not None
         # self-tuning host pipeline (serving.autotune / config.tuning):
         # the request microbatcher's close decisions move from the fixed
@@ -471,6 +482,7 @@ class ServingApp:
         r("GET", "/model-info", self._model_info)
         r("POST", "/reload-models", self._reload_models)
         r("GET", "/metrics/prometheus", self._metrics_prometheus)
+        r("GET", "/metrics/fleet", self._metrics_fleet)
         r("GET", "/drift", self._drift)
         r("POST", "/experiments", self._create_experiment)
         r("GET", "/experiments", self._experiment_results)
@@ -517,12 +529,32 @@ class ServingApp:
             uid = str(txn.get("user_id", ""))
             owner = self.cluster_router.route(uid)
             if owner != self.config.cluster.worker_id:
-                return 421, {
+                resp = {
                     "error": "wrong_shard",
                     "owner": owner,
                     "location": self.cluster_router.address_of(owner),
                     "partition": self.cluster_router.partition_of(uid),
                 }
+                carrier = txn.get("trace_carrier")
+                if carrier is not None:
+                    # redirect-aware carrier echo: bump the hop count so
+                    # the eventual consumer books this bounce under the
+                    # trace's redirect_hops stage; the caller copies the
+                    # returned carrier onto the re-issued request
+                    from realtime_fraud_detection_tpu.obs.tracing import (
+                        make_carrier,
+                        parse_carrier,
+                    )
+
+                    c = parse_carrier(carrier)
+                    if c is not None:
+                        resp["trace_carrier"] = make_carrier(
+                            c["tid"], origin=c["org"],
+                            produced_ts=c.get("ts"), priority=c["pr"],
+                            fault=c["flt"], parent=c["sp"],
+                            hops=int(c.get("rh", 0)) + 1,
+                            redirect_s=float(c.get("rs", 0.0)))
+                return 421, resp
         if self.qos.enabled:
             # QoS admission ahead of the concurrency gate: a shed is an
             # explicit score-with-reason (200, decision REVIEW, risk_level
@@ -658,6 +690,25 @@ class ServingApp:
         if self.netfaults is not None:
             self.metrics.sync_netfaults(self.netfaults.snapshot())
         return 200, self.metrics.render_prometheus()
+
+    async def _metrics_fleet(self, body, query) -> Tuple[int, Any]:
+        """Fleet-level Prometheus exposition: every worker's counters
+        under a ``{worker=...}`` label plus honest unlabeled fleet sums,
+        exactly one HELP/TYPE pair per family (obs/fleetmetrics.py).
+        This process's own tracing counters fold in at render time under
+        its cluster worker id, so a one-process deployment still renders
+        an honest one-worker fleet."""
+        from realtime_fraud_detection_tpu import __version__
+
+        local_id = self.config.cluster.worker_id or "serving"
+        if self.tracer is not None:
+            self.fleet_metrics.ingest_cumulative(
+                local_id,
+                {f"trace_{k}": v
+                 for k, v in self.tracer.counters.items()})
+            self.fleet_metrics.set_worker_info(
+                local_id, pid=os.getpid(), version=__version__)
+        return 200, self.fleet_metrics.render(version=__version__)
 
     def _cluster_snapshot(self) -> Dict[str, Any]:
         """Serving-side cluster snapshot (router truth only — the stream

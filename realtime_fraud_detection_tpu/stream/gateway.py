@@ -75,7 +75,8 @@ class IngressGateway:
     def __init__(self, broker: Any, topic: str,
                  key_fn: Optional[Callable[[Mapping[str, Any]], str]] = None,
                  capacity: int = 8192, max_batch: int = 512,
-                 max_delay_ms: float = 5.0, stamp_ingest: bool = False):
+                 max_delay_ms: float = 5.0, stamp_ingest: bool = False,
+                 tracer: Optional[Any] = None):
         self.broker = broker
         self.topic = topic
         self.key_fn = key_fn or (lambda r: str(r.get("user_id", "")))
@@ -87,6 +88,13 @@ class IngressGateway:
         # broker hop, not just broker-to-admission. Off by default — the
         # stamp adds a field to every produced record.
         self.stamp_ingest = bool(stamp_ingest)
+        # distributed tracing: with a Tracer attached (obs/tracing.py),
+        # every submitted txn additionally carries a root trace carrier
+        # (trace id + this process's origin + produce wall stamp) that
+        # the consuming worker re-hydrates — the consume-side wall stamp
+        # minus this one IS the broker_transit stage
+        self.tracer = tracer if tracer is not None \
+            and getattr(tracer, "enabled", False) else None
         self.sent = 0
         self.dropped = 0
         self.native = False
@@ -119,10 +127,16 @@ class IngressGateway:
         """Lock-free enqueue from any thread. False == ring full —
         backpressure, NOT a drop: the caller sheds or retries, and the
         ``dropped`` counter only ever counts records actually lost."""
-        if self.stamp_ingest:
+        if self.stamp_ingest or self.tracer is not None:
             txn = dict(txn)
             # rtfd-lint: allow[wall-clock] ingest stamp is wall-clock by contract (broker-lag attribution)
-            txn["ingest_ts"] = time.time()
+            now_wall = time.time()
+            if self.stamp_ingest:
+                txn["ingest_ts"] = now_wall
+            if self.tracer is not None:
+                carrier = self.tracer.root_carrier(produced_ts=now_wall)
+                if carrier is not None:
+                    txn["trace_carrier"] = carrier
         payload = json.dumps(txn, separators=(",", ":")).encode()
         if self._slot_bytes is not None and len(payload) > self._slot_bytes:
             # oversized for a ring slot: drain what's queued first so this

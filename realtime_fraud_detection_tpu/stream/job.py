@@ -106,6 +106,14 @@ class JobConfig:
     # terminal `shed` trace. None or enabled=False = off, and the scoring
     # path pays one `is None` branch per batch (the measured no-op path).
     tracing: Optional[Any] = None        # utils.config.TracingSettings|Tracer
+    # distributed tracing: when True, every consumed record is EXPECTED to
+    # carry a producer-stamped trace carrier (obs.tracing.CARRIER_KEY in
+    # the raw record value); a record without a parseable one opens a
+    # fresh root trace counted in the tracer's carrier_lost — the
+    # netfault-dropped-frame degradation contract. False (default) means
+    # carriers are adopted opportunistically when present, never counted
+    # as lost when absent (single-process deployments stay quiet).
+    expect_carrier: bool = False
     # self-tuning host pipeline (tuning/): a TuningSettings (or a live
     # TuningPlane) — the assembler's close decisions move from the fixed
     # deadline to the arrival-aware just-in-time controller, and the
@@ -334,6 +342,15 @@ class StreamJob:
             except (TypeError, ValueError):
                 return 0.0
 
+        expect_carrier = self.config.expect_carrier
+
+        def _carrier(rec: Record) -> Any:
+            # read from the RAW record value (the ingest_ts precedent):
+            # sanitize strips unknown fields, so the carrier must be
+            # lifted before the sanitized copy replaces the value
+            return rec.value.get("trace_carrier") \
+                if isinstance(rec.value, dict) else None
+
         for r in records:
             txn, errors = sanitize_for_stream(r.value)
             if errors:
@@ -376,7 +393,10 @@ class StreamJob:
                         tracer.finish_terminal(
                             tracer.begin(txn_id,
                                          ingest_lag_s=_ingest_lag(r),
-                                         priority=decision.priority),
+                                         priority=decision.priority,
+                                         carrier=_carrier(r),
+                                         now_wall=t_adm,
+                                         expect_carrier=expect_carrier),
                             "shed", reason=decision.reason,
                             priority=decision.priority)
                     continue
@@ -385,7 +405,9 @@ class StreamJob:
             if tracer is not None:
                 trace_ctxs.append(
                     tracer.begin(txn_id, ingest_lag_s=_ingest_lag(r),
-                                 priority=priority))
+                                 priority=priority, carrier=_carrier(r),
+                                 now_wall=t_adm,
+                                 expect_carrier=expect_carrier))
         positions = self.consumer.snapshot_positions()
         if self.qos is not None:
             # backlog signal, one ladder observation per dispatched

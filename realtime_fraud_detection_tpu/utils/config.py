@@ -284,6 +284,10 @@ class TracingSettings:
     """
 
     enabled: bool = False
+    # process identity stamped into minted trace ids and wire carriers
+    # ("" = single-process id format): what keeps two workers' fresh
+    # roots globally distinct when the coordinator stitches their rings
+    origin: str = ""
     # flight recorder: ring of the most recent completed traces, plus the
     # slowest-N kept verbatim (the tail exemplars Chrome-trace export and
     # /latency/breakdown surface regardless of ring churn)

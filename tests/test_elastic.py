@@ -690,8 +690,8 @@ class TestElasticSettingsAndScopes:
         )
 
         assert "elastic-drill" in LOCKWATCH_DRILLS
-        # twelve since ISSUE 17 added kernel-drill
-        assert len(LOCKWATCH_DRILLS) == 12
+        # thirteen since ISSUE 20 added obs-drill
+        assert len(LOCKWATCH_DRILLS) == 13
 
     def test_compact_summary_under_2kb_even_when_bloated(self):
         from realtime_fraud_detection_tpu.cluster.elastic_drill import (

@@ -501,8 +501,8 @@ class TestRegistrationAndSummary:
         )
 
         assert "partition-drill" in LOCKWATCH_DRILLS
-        # twelve since ISSUE 17 added kernel-drill
-        assert len(LOCKWATCH_DRILLS) == 12
+        # thirteen since ISSUE 20 added obs-drill
+        assert len(LOCKWATCH_DRILLS) == 13
 
     def test_netfaults_in_lint_scopes(self):
         from realtime_fraud_detection_tpu.analysis.lint import (
@@ -590,3 +590,66 @@ class TestPartitionDrillSmoke:
         assert full["checks"]["zombie_fenced_produce"] is True
         assert full["checks"]["state_equals_oracle"] is True
         assert full["checks"]["no_double_ownership"] is True
+
+
+# ---------------------------------------------------------------------------
+# trace-carrier loss inside a netfault window (ISSUE 20)
+# ---------------------------------------------------------------------------
+
+
+class TestCarrierLossUnderNetfault:
+    def test_stripped_carriers_count_exactly_and_never_wedge(self):
+        """A degrade/partition window that strips producer carriers must
+        degrade every affected consume to a fresh LOCAL root: counted in
+        ``trace_carrier_lost_total`` exactly once per stripped record,
+        with zero cross-worker trace-id attachment and every started
+        trace reaching a terminal (the no-wedge ledger)."""
+        from realtime_fraud_detection_tpu.obs.tracing import (
+            Tracer,
+            make_carrier,
+        )
+        from realtime_fraud_detection_tpu.utils.config import (
+            TracingSettings,
+        )
+
+        window = FaultWindow("carrier_strip", "netfault", 2.0, 4.0)
+        clock = {"w0": [0.0], "w1": [0.0]}
+        tracers = {w: Tracer(TracingSettings(enabled=True, ring_size=512,
+                                             origin=w),
+                             clock=lambda w=w: clock[w][0])
+                   for w in ("w0", "w1")}
+        stripped = {"w0": 0, "w1": 0}
+        for i in range(60):
+            wid = "w0" if i % 2 == 0 else "w1"
+            tracer = tracers[wid]
+            produced_ts = i * 0.1
+            in_window = window.t_start <= produced_ts < window.t_end
+            carrier = None if in_window else make_carrier(
+                f"ting-{i:04x}", origin="ingress",
+                produced_ts=produced_ts)
+            if in_window:
+                stripped[wid] += 1
+            ctx = tracer.begin(f"tx{i}", carrier=carrier,
+                               now_wall=produced_ts + 0.01,
+                               expect_carrier=True)
+            assert ctx is not None            # loss is never a wedge
+            tb = tracer.batch([ctx])
+            tb.mark("device_wait")
+            clock[wid][0] += 0.002
+            tracer.finish_batch(tb)
+        for wid, tracer in tracers.items():
+            c = tracer.counters
+            assert c["carrier_lost"] == stripped[wid]
+            assert c["carrier_adopted"] == 30 - stripped[wid]
+            # no-wedge ledger: started == sum of terminals
+            assert c["started"] == (c["completed"] + c["shed"]
+                                    + c["errors"] + c["cached"])
+        # zero cross-attachment: a trace id lands in exactly one
+        # worker's ring, and fresh roots carry the minting worker's id
+        ids = {w: {t.trace_id for t in tr.traces()}
+               for w, tr in tracers.items()}
+        assert not (ids["w0"] & ids["w1"])
+        for w, tr in tracers.items():
+            fresh = [t for t in tr.traces() if not t.origin]
+            assert len(fresh) == stripped[w]
+            assert all(t.trace_id.startswith(f"t{w}-") for t in fresh)

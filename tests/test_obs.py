@@ -611,3 +611,311 @@ def test_tracing_overhead_guard_real_scorer():
     off = min(soak(False), soak(False))
     on = min(soak(True), soak(True))
     assert on / off < 1.5, f"tracing overhead ratio {on / off:.3f} >= 1.5"
+
+
+# ---------------------------------------------------------------------------
+# distributed tracing + fleet aggregation (ISSUE 20)
+# ---------------------------------------------------------------------------
+
+class TestCarrier:
+    """Cross-process trace carrier: wire roundtrip, transit attribution,
+    redirect ledger, and loss accounting (fresh root, never a wedge)."""
+
+    def test_roundtrip_and_sparse_wire_form(self):
+        from realtime_fraud_detection_tpu.obs.tracing import (
+            make_carrier,
+            parse_carrier,
+        )
+
+        c = make_carrier("tingress-2a", origin="ingress", produced_ts=12.5,
+                         priority="high", hops=2, redirect_s=0.003)
+        # survives JSON framing (the broker wire) verbatim
+        p = parse_carrier(json.loads(json.dumps(c)))
+        assert p["tid"] == "tingress-2a" and p["org"] == "ingress"
+        assert p["ts"] == 12.5 and p["rh"] == 2 and p["rs"] == 0.003
+        # empty fields never ride the wire — the carrier stays tiny
+        assert set(make_carrier("t1")) == {"v", "tid"}
+
+    def test_parse_rejects_garbage(self):
+        from realtime_fraud_detection_tpu.obs.tracing import parse_carrier
+
+        for bad in (None, "x", 7, [], {}, {"tid": ""}, {"tid": 3}):
+            assert parse_carrier(bad) is None
+
+    def test_adopted_carrier_books_transit_additively(self):
+        from realtime_fraud_detection_tpu.obs.tracing import make_carrier
+
+        clock = [0.0]
+        tracer = _vclock_tracer(clock)
+        # produced at wall 10.0, consumed at wall 10.4; the record's own
+        # event-time lag is 0.5 s — ingest must shrink by the transit so
+        # the pre-admission segments never double-count one interval
+        c = make_carrier("tingress-1", origin="ingress", produced_ts=10.0)
+        ctx = tracer.begin("tx1", ingest_lag_s=0.5, carrier=c,
+                           now_wall=10.4)
+        tb = tracer.batch([ctx])
+        tb.mark("device_wait")
+        clock[0] += 0.010
+        tracer.finish_batch(tb)
+        (t,) = tracer.traces(terminal="scored")
+        assert t.trace_id == "tingress-1" and t.origin == "ingress"
+        assert t.stages["broker_transit"] == pytest.approx(400.0)
+        assert t.stages["ingest"] == pytest.approx(100.0)
+        assert sum(t.stages.values()) == pytest.approx(t.e2e_ms)
+        assert t.to_dict()["origin"] == "ingress"
+        assert tracer.counters["carrier_adopted"] == 1
+        assert tracer.counters["carrier_lost"] == 0
+
+    def test_redirect_ledger_is_a_stage(self):
+        from realtime_fraud_detection_tpu.obs.tracing import make_carrier
+
+        clock = [0.0]
+        tracer = _vclock_tracer(clock)
+        c = make_carrier("tserving-9", origin="serving", hops=1,
+                         redirect_s=0.002)
+        ctx = tracer.begin("tx2", carrier=c)
+        tracer.finish_terminal(ctx, "shed", reason="no_tokens")
+        (t,) = tracer.traces(terminal="shed")
+        assert t.stages["redirect_hops"] == pytest.approx(2.0)
+
+    def test_lost_carrier_degrades_to_fresh_local_root(self):
+        clock = [0.0]
+        tracer = Tracer(TracingSettings(enabled=True, ring_size=64,
+                                        origin="w7"),
+                        clock=lambda: clock[0])
+        # expected-but-missing and present-but-garbled both count as loss
+        lost1 = tracer.begin("tx3", expect_carrier=True)
+        lost2 = tracer.begin("tx4", carrier={"v": 1})
+        for ctx in (lost1, lost2):
+            # fresh LOCAL root: minted id carries THIS process's origin
+            # prefix, no adopted origin, no transit
+            assert ctx.trace_id.startswith("tw7-")
+            assert ctx.origin == "" and ctx.broker_transit_s == 0.0
+            tracer.finish_terminal(ctx, "shed", reason="test")
+        assert tracer.counters["carrier_lost"] == 2
+        assert tracer.counters["carrier_adopted"] == 0
+        # never a wedge: every started trace reached a terminal
+        c = tracer.counters
+        assert c["started"] == (c["completed"] + c["shed"] + c["errors"]
+                                + c["cached"])
+
+
+class TestLogTraceCorrelation:
+    def test_json_formatter_stamps_active_trace_context(self):
+        from realtime_fraud_detection_tpu.obs.tracing import (
+            clear_log_context,
+            set_log_context,
+        )
+
+        rec = logging.LogRecord("t", logging.INFO, __file__, 1, "in-batch",
+                                (), None)
+        set_log_context("tw2-0000002a", "w2")
+        try:
+            out = json.loads(JsonFormatter().format(rec))
+        finally:
+            clear_log_context()
+        assert out["trace_id"] == "tw2-0000002a"
+        assert out["worker"] == "w2"
+        # context cleared -> no stamp (and explicit record fields win)
+        rec2 = logging.LogRecord("t", logging.INFO, __file__, 1, "idle",
+                                 (), None)
+        out2 = json.loads(JsonFormatter().format(rec2))
+        assert "trace_id" not in out2 and "worker" not in out2
+
+
+class TestFleetMetrics:
+    def _fm(self):
+        from realtime_fraud_detection_tpu.obs.fleetmetrics import (
+            FleetMetrics,
+        )
+
+        return FleetMetrics()
+
+    def test_delta_fold_is_exact_and_dedupes_stale_seq(self):
+        fm = self._fm()
+        assert fm.ingest_delta({"worker": "w0", "seq": 1,
+                                "counters": {"scored_total": 3.0,
+                                             "shed": 0.0}})
+        assert fm.ingest_delta({"worker": "w1", "seq": 1,
+                                "counters": {"scored_total": 2.0}})
+        assert fm.ingest_delta({"worker": "w0", "seq": 2,
+                                "counters": {"scored_total": 4.0,
+                                             "shed": 1.0}})
+        # replayed/stale event is dropped, not double-counted
+        assert not fm.ingest_delta({"worker": "w0", "seq": 2,
+                                    "counters": {"scored_total": 99.0}})
+        fleet = fm.fleet_counters()
+        assert fleet["scored_total"] == 9.0
+        assert fleet["shed"] == 1.0
+        assert fm.worker_counters()["w0"]["scored_total"] == 7.0
+        snap = fm.snapshot()
+        assert snap["events_applied"] == 3 and snap["events_stale"] == 1
+        assert snap["seq"] == {"w0": 2, "w1": 1}
+
+    def test_render_prometheus_hygiene(self):
+        fm = self._fm()
+        fm.ingest_cumulative("w0", {"scored_total": 3, "shed": 1})
+        fm.ingest_cumulative("w1", {"scored_total": 2})
+        fm.set_worker_info("w0", pid="123", version="0.1.0")
+        text = fm.render(version="0.1.0")
+        lines = text.splitlines()
+        # exactly one HELP/TYPE pair per family, HELP immediately
+        # followed by TYPE
+        helps = [ln.split()[2] for ln in lines if ln.startswith("# HELP")]
+        types = [ln.split()[2] for ln in lines if ln.startswith("# TYPE")]
+        assert helps == sorted(set(helps))
+        assert types == helps
+        # counter suffix normalization: never _total_total, and keys
+        # without the suffix gain it exactly once
+        assert "_total_total" not in text
+        assert 'rtfd_worker_shed_total{worker="w0"} 1' in lines
+        # the unlabeled fleet sum equals the per-worker sum
+        assert "rtfd_fleet_scored_total 5" in lines
+        # identity gauges
+        assert any(ln.startswith("rtfd_build_info{")
+                   and 'version="0.1.0"' in ln and ln.endswith(" 1")
+                   for ln in lines)
+        assert any(ln.startswith("fleet_worker_info{")
+                   and 'pid="123"' in ln and 'worker="w0"' in ln
+                   for ln in lines)
+
+
+def _trace_row(tid, txn, worker_s, t_start, stages, origin="",
+               terminal="scored", spans=None):
+    e2e = sum(stages.values())
+    meta = {"spans": spans} if spans else {}
+    row = {"trace_id": tid, "txn_id": txn, "t_start": t_start,
+           "e2e_ms": e2e, "stages": dict(stages), "meta": meta,
+           "terminal": terminal, "priority": ""}
+    if origin:
+        row["origin"] = origin
+    return row
+
+
+class TestFleetTraceStore:
+    def _store(self, **kw):
+        from realtime_fraud_detection_tpu.obs.fleetmetrics import (
+            FleetTraceStore,
+        )
+
+        return FleetTraceStore(**kw)
+
+    def test_stitch_stats_crossed_fresh_and_remote(self):
+        st = self._store()
+        st.ingest("w0", [
+            _trace_row("tingress-1", "a", "w0", 1.0,
+                       {"ingest": 1.0, "broker_transit": 4.0,
+                        "device_wait": 2.0}, origin="ingress"),
+            _trace_row("tw0-1", "b", "w0", 1.1, {"device_wait": 2.0}),
+        ], pid=41)
+        st.ingest("w1", [
+            _trace_row("tingress-2", "c", "w1", 1.2,
+                       {"ingest": 0.5, "broker_transit": 8.0,
+                        "device_wait": 2.0,
+                        "remote_fetch": 1.5}, origin="ingress",
+                       spans=[{"name": "remote_fetch", "ms": 1.5}]),
+        ], pid=42)
+        s = st.stitch_stats()
+        assert s["total"] == 3
+        assert s["crossed_process"] == 2
+        assert s["fresh_roots"] == 1
+        assert s["with_remote_span"] == 1
+        assert s["stitch_rate"] == pytest.approx(2 / 3, abs=1e-3)
+        assert s["broker_transit_ms"]["n"] == 2
+        assert s["broker_transit_ms"]["max"] == pytest.approx(8.0)
+
+    def test_breakdown_attributes_dominant_worker(self):
+        st = self._store()
+        # w0 fast, w1 the slow worker: device_wait owns w1's traces and
+        # w1 owns the fleet tail
+        st.ingest("w0", [
+            _trace_row(f"tw0-{i}", f"f{i}", "w0", 1.0 + i * 0.01,
+                       {"assemble": 1.0, "device_wait": 2.0})
+            for i in range(10)])
+        st.ingest("w1", [
+            _trace_row(f"tw1-{i}", f"s{i}", "w1", 1.0 + i * 0.01,
+                       {"assemble": 1.0, "device_wait": 90.0 + i})
+            for i in range(10)])
+        bd = st.breakdown()
+        assert bd["n"] == 20
+        for q in ("p50", "p95", "p99"):
+            assert bd["quantiles"][q]["dominant_worker"] == "w1"
+            assert bd["quantiles"][q]["dominant_stage"] == "device_wait"
+        assert bd["per_worker"]["w1"]["dominant_stage"] == "device_wait"
+        assert bd["exemplars"][0]["worker"] == "w1"
+
+    def test_export_draws_flow_arrows_across_the_broker_hop(self):
+        st = self._store()
+        st.ingest("w0", [
+            _trace_row("tingress-1", "a", "w0", 1.0,
+                       {"ingest": 1.0, "broker_transit": 4.0,
+                        "device_wait": 2.0}, origin="ingress"),
+            _trace_row("tw0-1", "b", "w0", 1.1, {"device_wait": 2.0}),
+        ], pid=41)
+        payload = st.export_chrome_trace()
+        ev = payload["traceEvents"]
+        track_names = {e["args"]["name"] for e in ev if e["ph"] == "M"}
+        assert "worker w0 (pid 41)" in track_names
+        assert "ingress ingress" in track_names
+        starts = [e for e in ev if e["ph"] == "s"]
+        ends = [e for e in ev if e["ph"] == "f"]
+        assert len(starts) == len(ends) == 1      # one crossed trace
+        assert starts[0]["pid"] != ends[0]["pid"]  # arrow crosses tracks
+        # the stitched trace's transit slice draws on the ORIGIN track
+        transit = [e for e in ev if e["ph"] == "X"
+                   and e["name"] == "broker_transit"]
+        assert transit[0]["pid"] == starts[0]["pid"]
+        json.dumps(payload)
+
+    def test_merge_chrome_traces_folds_ring_dumps(self):
+        from realtime_fraud_detection_tpu.obs.fleetmetrics import (
+            merge_chrome_traces,
+        )
+
+        dumps = [
+            {"worker": "w0", "pid": 41, "traces": [
+                _trace_row("tingress-1", "a", "w0", 1.0,
+                           {"ingest": 1.0, "broker_transit": 4.0,
+                            "device_wait": 2.0}, origin="ingress")]},
+            {"worker": "w1", "pid": 42, "traces": [
+                _trace_row("tw1-1", "b", "w1", 1.1,
+                           {"device_wait": 2.0})]},
+        ]
+        merged = merge_chrome_traces(dumps)
+        tracks = merged["metadata"]["tracks"]
+        assert {"w0", "w1", "ingress"} <= set(tracks)
+        assert merged["metadata"]["n_traces"] == 2
+        assert any(e["ph"] == "s" for e in merged["traceEvents"])
+
+
+def test_obs_drill_fast_smoke(capsys):
+    """The `rtfd obs-drill --fast --no-replay` acceptance path runs
+    un-slow-marked on every tier-1 pass — ≥2 real OS worker processes,
+    producer-stamped carriers over the TCP netbroker, the netfault
+    carrier-strip window, fleet-metric exactness, and the compact <2 KB
+    verdict as the final stdout line. One retry absorbs a wall-clock
+    scheduling stall on oversubscribed CI hosts (the drill's overhead
+    ratio and p99 attribution are real-time measurements over real OS
+    processes — the `_dryrun_multihost` retry discipline); a retried
+    pass still proves the plane, a double failure fails the gate."""
+    from realtime_fraud_detection_tpu import cli
+
+    rc = cli.main(["obs-drill", "--fast", "--no-replay"])
+    if rc != 0:
+        capsys.readouterr()                       # drop the failed pass
+        rc = cli.main(["obs-drill", "--fast", "--no-replay"])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    compact = json.loads(out[-1])
+    assert len(out[-1].encode()) < 2048
+    assert compact["passed"] is True
+    assert compact["crossed"] > 0
+    carriers = compact["carriers"]
+    assert carriers["lost_total"] == carriers["stripped"]
+    # "carried" counts every record that kept its carrier (redirect
+    # records included) — adoption must match it exactly
+    assert carriers["adopted_total"] == carriers["carried"]
+    full = json.loads(out[-2])
+    assert full["checks"]["fleet_counters_exact"]
+    assert full["checks"]["no_cross_attachment"]
+    assert full["checks"]["broker_transit_nonzero"]
